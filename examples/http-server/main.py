@@ -1,0 +1,41 @@
+"""Hello-world HTTP server: north-star config 1 (BASELINE.md).
+
+Mirrors the reference's examples/http-server/main.go: a few routes over the
+full middleware chain, a KV round-trip, an outbound service call, and the
+framework's well-known health routes.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from gofr_tpu import App  # noqa: E402
+
+
+def main() -> None:
+    os.chdir(os.path.dirname(os.path.abspath(__file__)))
+    app = App()
+
+    @app.get("/hello")
+    def hello(ctx):
+        name = ctx.param("name") or "World"
+        return f"Hello {name}!"
+
+    @app.post("/echo")
+    def echo(ctx):
+        return ctx.bind()
+
+    @app.get("/counter")
+    def counter(ctx):
+        return {"count": ctx.kv.incr("visits")}
+
+    @app.get("/error")
+    def error(ctx):
+        raise RuntimeError("deliberate failure")
+
+    app.run()
+
+
+if __name__ == "__main__":
+    main()

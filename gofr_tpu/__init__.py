@@ -1,0 +1,27 @@
+"""gofr_tpu: a TPU-native microservice + model-serving framework.
+
+Built from scratch with the capability surface of the reference framework
+surveyed in SURVEY.md (App facade, DI container, HTTP/gRPC/metrics servers,
+middleware, datasources, pub/sub, cron, migrations, circuit breaker, CRUD,
+swagger) plus a first-class TPU serving runtime: JAX/XLA executors with an
+AOT compile cache, dynamic and continuous batching schedulers, device-resident
+KV cache, and mesh parallelism (dp/tp/sp/pp) for multi-chip serving.
+"""
+
+from .app import App, new_app
+from .cmd import new_cmd
+from .config import Config, EnvFile, MockConfig
+from .container import Container, new_mock_container
+from .context import Context
+from .http.errors import (EntityAlreadyExists, EntityNotFound, HTTPError,
+                          InvalidParam, MissingParam)
+from .http.responder import File, Raw, Redirect, Response, Stream
+from .version import FRAMEWORK
+
+__version__ = FRAMEWORK
+__all__ = [
+    "App", "new_app", "new_cmd", "Config", "EnvFile", "MockConfig",
+    "Container", "new_mock_container", "Context", "EntityAlreadyExists",
+    "EntityNotFound", "HTTPError", "InvalidParam", "MissingParam",
+    "File", "Raw", "Redirect", "Response", "Stream", "FRAMEWORK",
+]

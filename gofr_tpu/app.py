@@ -1,0 +1,325 @@
+"""App facade: one object that boots HTTP/metrics/gRPC servers, subscribers,
+cron jobs, and (TPU-era) the model-serving engine.
+
+Parity: reference pkg/gofr/gofr.go — New/NewCMD (:63-112), route verbs
+(:210-241), Subscribe (:360-368), AddHTTPService (:197-207), Migrate
+(:257-262), AddCronJob (:390-400), AddRESTHandlers (:370-383), Enable*Auth
+(:324-358), UseMiddleware (:386-388), Run (:115-178); default ports 8000 /
+9000 / 2121 (default.go:3-7); handler timeout + health/alive/catch-all
+(handler.go:18-102); metrics server (metricsServer.go:20-34).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, Optional
+
+from .config import Config, EnvFile
+from .container import Container
+from .context import Context
+from .http import middleware as mw
+from .http.errors import HTTPError, RequestTimeout
+from .http.request import Request
+from .http.responder import File, Responder, Response, Stream
+from .http.router import Router
+from .http.server import HTTPServer
+from .subscriber import SubscriptionManager
+
+DEFAULT_HTTP_PORT = 8000     # default.go:3-7
+DEFAULT_GRPC_PORT = 9000
+DEFAULT_METRICS_PORT = 2121
+DEFAULT_REQUEST_TIMEOUT_S = 5.0  # handler.go:18
+
+Handler = Callable[[Context], Any]
+
+_FAVICON = bytes.fromhex(  # 1x1 transparent gif, stands in for static/favicon.ico
+    "47494638396101000100800000000000ffffff21f90401000001002c00000000010001000002024c01003b")
+
+
+class App:
+    def __init__(self, config_dir: Optional[str] = None, config: Optional[Config] = None,
+                 container: Optional[Container] = None):
+        if container is not None:
+            self.container = container
+            self.config = container.config
+        else:
+            if config is None:
+                config_dir = config_dir or os.environ.get("GOFR_CONFIGS_DIR", "./configs")
+                config = EnvFile(config_dir)
+            self.config = config
+            self.container = Container.create(config)
+
+        self.logger = self.container.logger
+        self.router = Router()
+        self.request_timeout_s = self.config.get_float("REQUEST_TIMEOUT", DEFAULT_REQUEST_TIMEOUT_S)
+        self.http_port = self.config.get_int("HTTP_PORT", DEFAULT_HTTP_PORT)
+        self.grpc_port = self.config.get_int("GRPC_PORT", DEFAULT_GRPC_PORT)
+        self.metrics_port = self.config.get_int("METRICS_PORT", DEFAULT_METRICS_PORT)
+
+        self._http_server: Optional[HTTPServer] = None
+        self._metrics_server: Optional[HTTPServer] = None
+        self._grpc_server = None
+        self._grpc_services: list = []
+        self._subscriptions = SubscriptionManager(self.container)
+        self._cron = None
+        self._user_middleware: list = []
+        self._static_dirs: Dict[str, str] = {}
+        self._openapi_path = "./static/openapi.json"
+        self._started = False
+
+        # default chain: Tracer -> Logging -> CORS -> Metrics (http/router.go:21-33)
+        self.router.use_middleware(
+            mw.tracer_middleware(self.container.tracer),
+            mw.logging_middleware(self.logger),
+            mw.cors_middleware(),
+            mw.metrics_middleware(self.container.metrics_manager),
+        )
+
+    # -- route registration ---------------------------------------------------
+    def add_route(self, method: str, pattern: str, handler: Optional[Handler] = None):
+        if handler is None:  # decorator form: @app.get("/path")
+            def decorator(fn: Handler) -> Handler:
+                self.add_route(method, pattern, fn)
+                return fn
+            return decorator
+        self.router.add(method, pattern, self._wire(handler))
+        return handler
+
+    def get(self, pattern: str, handler: Optional[Handler] = None):
+        return self.add_route("GET", pattern, handler)
+
+    def post(self, pattern: str, handler: Optional[Handler] = None):
+        return self.add_route("POST", pattern, handler)
+
+    def put(self, pattern: str, handler: Optional[Handler] = None):
+        return self.add_route("PUT", pattern, handler)
+
+    def patch(self, pattern: str, handler: Optional[Handler] = None):
+        return self.add_route("PATCH", pattern, handler)
+
+    def delete(self, pattern: str, handler: Optional[Handler] = None):
+        return self.add_route("DELETE", pattern, handler)
+
+    # -- handler adapter (handler.go:41-76) -----------------------------------
+    def _wire(self, handler: Handler):
+        def wire_handler(request: Request) -> Response:
+            responder = Responder(request.method)
+            deadline = time.time() + self.request_timeout_s if self.request_timeout_s > 0 else None
+            ctx = Context(request=request, container=self.container,
+                          responder=responder, deadline=deadline)
+            result: Dict[str, Any] = {}
+            done = threading.Event()
+
+            def run() -> None:
+                try:
+                    result["data"] = handler(ctx)
+                except BaseException as exc:  # noqa: BLE001 - surfaced via responder
+                    result["err"] = exc
+                finally:
+                    done.set()
+
+            # the reference runs the user handler in its own goroutine and
+            # responds 408 if the deadline passes first, leaving the handler
+            # running (handler.go:58-75); same model with a thread here
+            t = threading.Thread(target=run, name="handler", daemon=True)
+            t.start()
+            done.wait(timeout=None if deadline is None else self.request_timeout_s)
+            if not done.is_set():
+                return responder.respond(None, RequestTimeout())
+            err = result.get("err")
+            if err is not None and not isinstance(err, Exception):
+                raise err  # SystemExit/KeyboardInterrupt propagate
+            return responder.respond(result.get("data"), err)
+
+        return wire_handler
+
+    # -- middleware & auth ----------------------------------------------------
+    def use_middleware(self, *mws) -> None:
+        self.router.use_middleware(*mws)
+
+    def enable_basic_auth(self, *creds: str, users: Optional[Dict[str, str]] = None,
+                          validate_func=None) -> None:
+        userdict = dict(users or {})
+        for i in range(0, len(creds) - 1, 2):
+            userdict[creds[i]] = creds[i + 1]
+        self.router.use_middleware(mw.basic_auth_middleware(userdict, validate_func))
+
+    def enable_api_key_auth(self, *keys: str, validate_func=None) -> None:
+        self.router.use_middleware(mw.api_key_auth_middleware(keys, validate_func))
+
+    def enable_oauth(self, secret: str) -> None:
+        self.router.use_middleware(mw.oauth_middleware(secret))
+
+    # -- cross-cutting registrations ------------------------------------------
+    def add_http_service(self, name: str, address: str, *options) -> None:
+        from .service import new_http_service
+
+        self.container.services[name] = new_http_service(
+            address, self.logger, self.container.metrics_manager, *options)
+
+    def subscribe(self, topic: str, handler: Optional[Handler] = None):
+        if handler is None:
+            def decorator(fn: Handler) -> Handler:
+                self.subscribe(topic, fn)
+                return fn
+            return decorator
+        if self.container.get_subscriber() is None:
+            self.logger.error("pub/sub not configured; set PUBSUB_BACKEND (gofr.go:360-368 parity)")
+            return handler
+        self._subscriptions.register(topic, handler)
+        return handler
+
+    def migrate(self, migrations: Dict[int, Any]) -> None:
+        from .migration import run as run_migrations
+
+        try:
+            run_migrations(migrations, self.container)
+        except Exception as exc:  # noqa: BLE001 - migrate panics are recovered (gofr.go:259)
+            self.logger.errorf("migration failed: %s", exc)
+
+    def add_cron_job(self, spec: str, name: str, fn: Handler) -> None:
+        if self._cron is None:
+            from .cron import Crontab
+
+            self._cron = Crontab(self.container)
+        self._cron.add_job(spec, name, fn)
+
+    def add_rest_handlers(self, entity_cls: type, table: Optional[str] = None) -> None:
+        from .crud import register_crud_handlers
+
+        register_crud_handlers(self, entity_cls, table)
+
+    def register_grpc_service(self, service) -> None:
+        self._grpc_services.append(service)
+
+    def add_tpu(self, tpu_client) -> None:
+        """Inject a TPU device client (the Mongo provider pattern, externalDB.go:5-12)."""
+        tpu_client.use_logger(self.logger)
+        tpu_client.use_metrics(self.container.metrics_manager)
+        tpu_client.connect()
+        self.container.tpu = tpu_client
+
+    def add_static_files(self, route_prefix: str, directory: str) -> None:
+        self._static_dirs[route_prefix.rstrip("/")] = directory
+
+    # -- well-known routes (handler.go:78-102, swagger.go) --------------------
+    def _register_framework_routes(self) -> None:
+        def health_handler(ctx: Context):
+            return ctx.container.health()
+
+        def alive_handler(ctx: Context):
+            return {"status": "UP"}
+
+        self.router.add("GET", "/.well-known/health", self._wire(health_handler))
+        self.router.add("GET", "/.well-known/alive", self._wire(alive_handler))
+        self.router.add("GET", "/favicon.ico", lambda req: Response(
+            status=200, headers={"Content-Type": "image/gif"}, body=_FAVICON))
+
+        if os.path.isfile(self._openapi_path):
+            from .swagger import openapi_handler, swagger_ui_handler
+
+            self.router.add("GET", "/.well-known/openapi.json",
+                            self._wire(openapi_handler(self._openapi_path)))
+            self.router.add("GET", "/.well-known/swagger",
+                            self._wire(swagger_ui_handler()))
+
+        for prefix, directory in self._static_dirs.items():
+            self.router.add("GET", prefix + "/{filename}", self._static_handler(directory))
+
+    def _static_handler(self, directory: str):
+        def handle(request: Request) -> Response:
+            import mimetypes
+
+            name = os.path.basename(request.path_params.get("filename", ""))
+            path = os.path.join(directory, name)
+            if not os.path.isfile(path):
+                return Response(status=404, body=b'{"error":{"message":"not found"}}',
+                                headers={"Content-Type": "application/json"})
+            ctype = mimetypes.guess_type(path)[0] or "application/octet-stream"
+            with open(path, "rb") as fp:
+                return Response(status=200, headers={"Content-Type": ctype}, body=fp.read())
+
+        return handle
+
+    def _metrics_router(self) -> Router:
+        router = Router()
+
+        def metrics_handler(request: Request) -> Response:
+            self.container.refresh_runtime_metrics()
+            return Response(status=200, headers={"Content-Type": "text/plain; version=0.0.4"},
+                            body=self.container.metrics_manager.expose().encode())
+
+        def health_handler(request: Request) -> Response:
+            return Response(status=200, headers={"Content-Type": "application/json"},
+                            body=json.dumps(self.container.health()).encode())
+
+        router.add("GET", "/metrics", metrics_handler)
+        router.add("GET", "/.well-known/health", health_handler)
+        router.add("GET", "/.well-known/alive", lambda r: Response(
+            status=200, headers={"Content-Type": "application/json"}, body=b'{"status":"UP"}'))
+        return router
+
+    # -- lifecycle (gofr.go:115-178) ------------------------------------------
+    def start(self) -> None:
+        """Start all servers without blocking (tests + embedding)."""
+        if self._started:
+            return
+        self._started = True
+        self._register_framework_routes()
+
+        self._metrics_server = HTTPServer(self._metrics_router(), self.metrics_port, self.logger)
+        try:
+            self._metrics_server.start()
+            self.metrics_port = self._metrics_server.port
+        except OSError as exc:
+            self.logger.errorf("metrics server failed to start: %s", exc)
+            self._metrics_server = None
+
+        self._http_server = HTTPServer(self.router, self.http_port, self.logger)
+        self._http_server.start()
+        self.http_port = self._http_server.port
+
+        if self._grpc_services:
+            from .grpcx import GRPCServer
+
+            self._grpc_server = GRPCServer(self.container, self.grpc_port, self.logger)
+            for svc in self._grpc_services:
+                self._grpc_server.register(svc)
+            self._grpc_server.start()
+            self.grpc_port = self._grpc_server.port
+
+        self._subscriptions.start()
+        if self._cron is not None:
+            self._cron.start()
+        self.logger.infof("app %s started: http=:%d metrics=:%d",
+                          self.container.app_name, self.http_port, self.metrics_port)
+
+    def run(self) -> None:
+        """Start everything and block (the reference's wg.Wait, gofr.go:177)."""
+        self.start()
+        try:
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            self.shutdown()
+
+    def shutdown(self) -> None:
+        self._subscriptions.stop()
+        if self._cron is not None:
+            self._cron.stop()
+        for server in (self._http_server, self._metrics_server):
+            if server is not None:
+                server.shutdown()
+        if self._grpc_server is not None:
+            self._grpc_server.stop()
+        if self.container.tpu is not None and hasattr(self.container.tpu, "stop"):
+            self.container.tpu.stop()
+        self.container.close()
+        self._started = False
+
+
+def new_app(config_dir: Optional[str] = None, **kwargs) -> App:
+    return App(config_dir=config_dir, **kwargs)

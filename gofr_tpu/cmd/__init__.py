@@ -1,0 +1,154 @@
+"""CLI runtime: regex-matched subcommands, flag parsing, stdout/stderr responder.
+
+Parity: reference pkg/gofr/cmd.go:27-70 (strip flags, regex route match, run
+handler, respond to stdout/stderr) and pkg/gofr/cmd/request.go:25-116 (flags
+`-a=b` / `--x` / `-h` parsed to params, reflection Bind of params into
+structs), cmd/responder.go:8-19.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+import sys
+from typing import Any, Callable, Dict, List, Optional
+
+from ..container import Container
+from ..context import Context
+
+
+class CMDRequest:
+    """Args + parsed flag params; implements the transport Request interface."""
+
+    def __init__(self, args: List[str]):
+        self.raw_args = list(args)
+        self.positional: List[str] = []
+        self._params: Dict[str, str] = {}
+        self.span = None
+        self.context: Dict[str, Any] = {}
+        for arg in args:
+            if arg.startswith("-"):
+                stripped = arg.lstrip("-")
+                if "=" in stripped:
+                    key, _, val = stripped.partition("=")
+                    self._params[key] = val
+                else:
+                    self._params[stripped] = "true"
+            else:
+                self.positional.append(arg)
+
+    def param(self, key: str) -> str:
+        return self._params.get(key, "")
+
+    def params(self, key: str) -> List[str]:
+        val = self._params.get(key)
+        return [val] if val is not None else []
+
+    def path_param(self, key: str) -> str:
+        return self.param(key)
+
+    def host_name(self) -> str:
+        import socket
+
+        return socket.gethostname()
+
+    def bind(self, target: Any = None) -> Any:
+        """Bind parsed flag params into a dataclass/dict (cmd/request.go:89-116)."""
+        if target is None:
+            return dict(self._params)
+        if isinstance(target, type) and dataclasses.is_dataclass(target):
+            names = {f.name: f.type for f in dataclasses.fields(target)}
+            kwargs = {}
+            for k, v in self._params.items():
+                if k in names:
+                    kwargs[k] = _coerce(v, names[k])
+            return target(**kwargs)
+        if isinstance(target, dict):
+            target.update(self._params)
+            return target
+        for k, v in self._params.items():
+            setattr(target, k, v)
+        return target
+
+
+def _coerce(val: str, ftype) -> Any:
+    if ftype in (int, "int"):
+        return int(val)
+    if ftype in (float, "float"):
+        return float(val)
+    if ftype in (bool, "bool"):
+        return val.lower() in ("1", "true", "yes")
+    return val
+
+
+class CMDResponder:
+    """Data to stdout, errors to stderr (cmd/responder.go:8-19)."""
+
+    def __init__(self, out=None, err=None):
+        self.out = out or sys.stdout
+        self.err = err or sys.stderr
+
+    def respond(self, data: Any, err: Optional[BaseException]) -> int:
+        if err is not None:
+            self.err.write(str(err) + "\n")
+            return 1
+        if data is not None:
+            self.out.write(str(data) + "\n")
+        return 0
+
+
+class CMDApp:
+    """gofr.NewCMD() analog. Routes are regex patterns over the subcommand."""
+
+    def __init__(self, container: Optional[Container] = None, config=None):
+        from ..config import EnvFile
+
+        if container is None:
+            container = Container.create(config if config is not None else EnvFile("./configs"))
+        self.container = container
+        self.logger = container.logger
+        self._routes: List[tuple] = []
+
+    def sub_command(self, pattern: str, handler: Optional[Callable] = None,
+                    description: str = ""):
+        if handler is None:
+            def decorator(fn):
+                self.sub_command(pattern, fn, description)
+                return fn
+            return decorator
+        self._routes.append((re.compile(f"^{pattern}$"), handler, description))
+        return handler
+
+    def run(self, argv: Optional[List[str]] = None) -> int:
+        argv = list(sys.argv[1:] if argv is None else argv)
+        subcommand = ""
+        for arg in argv:
+            if not arg.startswith("-"):
+                subcommand = arg
+                break
+        responder = CMDResponder()
+        rest = list(argv)
+        if subcommand:
+            rest.remove(subcommand)  # only the first occurrence is the subcommand
+        request = CMDRequest(rest)
+
+        handler = None
+        for regex, fn, _desc in self._routes:
+            if regex.match(subcommand):
+                handler = fn
+                break
+        if handler is None:
+            known = ", ".join(d or r.pattern.strip("^$") for r, _f, d in self._routes)
+            return responder.respond(None, Exception(
+                f"No Command Found! Available: {known}" if known else "No Command Found!"))
+
+        ctx = Context(request=request, container=self.container, responder=responder)
+        try:
+            result = handler(ctx)
+        except Exception as exc:  # noqa: BLE001 - CLI reports, not crashes
+            return responder.respond(None, exc)
+        return responder.respond(result, None)
+
+
+def new_cmd(config=None, container=None) -> CMDApp:
+    return CMDApp(container=container, config=config)

@@ -1,0 +1,195 @@
+"""DI Container: the central holder of logger, metrics, tracer, and datasources.
+
+Parity: reference pkg/gofr/container/container.go — Container struct :27-40,
+`Create` building datasources from config :57-132 (pub/sub backend switch
+:86-131), framework metric registration :144-176, aggregate Health
+(container/health.go:39-59), GetHTTPService / publisher / subscriber accessors.
+
+TPU mapping (SURVEY.md §1): the TPU device client is a first-class datasource
+here — built from config when MODEL/TPU settings exist, or injected via
+App.add_tpu() following the reference's Mongo provider pattern
+(externalDB.go:5-12, datasource/mongo.go:142-155).
+"""
+
+from __future__ import annotations
+
+import gc
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from .. import version
+from ..config import Config, MockConfig
+from ..datasource import Health, STATUS_DEGRADED, STATUS_DOWN, STATUS_UP
+from ..logging import Level, Logger, MockLogger, new_logger, parse_level
+from ..metrics import Manager as MetricsManager
+from ..tracing import Tracer, exporter_from_config
+
+HTTP_BUCKETS = (0.001, 0.003, 0.01, 0.03, 0.1, 0.3, 1, 3, 10, 30)        # container.go:154
+SQL_BUCKETS = (5e-5, 1e-4, 3e-4, 1e-3, 2e-3, 3e-3, 5e-3, 7.5e-3, 1e-2)   # container.go:160
+KV_BUCKETS = (5e-5, 1e-4, 3e-4, 5e-4, 1e-3, 2e-3, 3e-3)                  # container.go:166
+
+
+class Container:
+    def __init__(self, config: Config, logger: Optional[Logger] = None):
+        self.config = config
+        self.logger = logger or new_logger(parse_level(config.get_or_default("LOG_LEVEL", "INFO")))
+        self.metrics_manager: Optional[MetricsManager] = None
+        self.tracer: Optional[Tracer] = None
+        self.sql = None
+        self.kv = None
+        self.pubsub = None
+        self.tpu = None
+        self.services: Dict[str, Any] = {}
+        self.app_name = config.get_or_default("APP_NAME", "gofr-tpu-app")
+        self.app_version = config.get_or_default("APP_VERSION", "dev")
+        self._started_at = time.time()
+
+    # -- construction ---------------------------------------------------------
+    @classmethod
+    def create(cls, config: Config) -> "Container":
+        c = cls(config)
+        c.logger.debugf("container created for app %s", c.app_name)
+
+        c.metrics_manager = MetricsManager(logger=c.logger)
+        c.register_framework_metrics()
+        c.metrics_manager.increment_counter(
+            "app_info", 1, app_name=c.app_name, app_version=c.app_version,
+            framework_version=version.FRAMEWORK)
+
+        c.tracer = Tracer(service_name=c.app_name,
+                          exporter=exporter_from_config(config, c.logger))
+
+        remote_url = config.get_or_default("REMOTE_LOG_URL", "")
+        if remote_url:
+            from ..logging.remote import start_remote_level_updater
+            interval = config.get_float("REMOTE_LOG_FETCH_INTERVAL", 15.0)
+            start_remote_level_updater(c.logger, remote_url, interval)
+
+        if config.get_or_default("DB_DIALECT", "") or config.get_or_default("DB_PATH", ""):
+            from ..datasource.sql import SQL
+            c.sql = SQL(config, c.logger, c.metrics_manager)
+
+        if config.get_bool("KV_ENABLED", False) or config.get_or_default("KV_STORE", ""):
+            from ..datasource.kvstore import KVStore
+            c.kv = KVStore(config, c.logger, c.metrics_manager)
+
+        backend = config.get_or_default("PUBSUB_BACKEND", "").lower()
+        if backend in ("inproc", "memory"):
+            from ..pubsub.inproc import InProcBroker
+            c.pubsub = InProcBroker(config, c.logger, c.metrics_manager)
+        elif backend:
+            c.logger.errorf("unsupported PUBSUB_BACKEND %r (bundled: inproc); pub/sub disabled",
+                            backend)
+
+        if config.get_bool("TPU_ENABLED", False) or config.get_or_default("MODEL_NAME", ""):
+            try:
+                from ..tpu.device import TPUClient
+                c.tpu = TPUClient.from_config(config, c.logger, c.metrics_manager)
+            except Exception as exc:  # noqa: BLE001 - boot survives a bad datasource
+                c.logger.errorf("could not initialise TPU client: %s", exc)
+
+        return c
+
+    def register_framework_metrics(self) -> None:
+        m = self.metrics_manager
+        m.new_counter("app_info", "static app information")
+        m.new_gauge("app_python_threads", "live python threads")
+        m.new_gauge("app_python_gc_objects", "objects tracked by gc")
+        m.new_gauge("app_uptime_seconds", "seconds since container start")
+        m.new_histogram("app_http_response", "http response time in seconds", HTTP_BUCKETS)
+        m.new_histogram("app_http_service_response", "outbound http call time in seconds", HTTP_BUCKETS)
+        m.new_histogram("app_sql_stats", "sql query time in seconds", SQL_BUCKETS)
+        m.new_histogram("app_kv_stats", "kv command time in seconds", KV_BUCKETS)
+        m.new_counter("app_pubsub_publish_total_count", "messages published")
+        m.new_counter("app_pubsub_subscribe_total_count", "messages received")
+        m.new_counter("app_pubsub_commit_total_count", "messages committed")
+        m.new_counter("app_pubsub_subscribe_failure_count", "handler failures")
+
+    def refresh_runtime_metrics(self) -> None:
+        """Refreshed per metrics scrape (metrics/handler.go:21-35)."""
+        m = self.metrics_manager
+        if m is None:
+            return
+        m.set_gauge("app_python_threads", threading.active_count())
+        m.set_gauge("app_python_gc_objects", len(gc.get_objects()) if gc.isenabled() else 0)
+        m.set_gauge("app_uptime_seconds", time.time() - self._started_at)
+
+    # -- accessors ------------------------------------------------------------
+    def metrics(self) -> MetricsManager:
+        return self.metrics_manager
+
+    def get_http_service(self, name: str):
+        svc = self.services.get(name)
+        if svc is None:
+            self.logger.errorf("http service %s not registered", name)
+        return svc
+
+    def get_publisher(self):
+        return self.pubsub
+
+    def get_subscriber(self):
+        return self.pubsub
+
+    # -- aggregate health (container/health.go:39-59) -------------------------
+    def health(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {
+            "name": self.app_name,
+            "version": self.app_version,
+            "framework": version.FRAMEWORK,
+            "status": STATUS_UP,
+            "uptime_s": round(time.time() - self._started_at, 1),
+        }
+        details: Dict[str, Any] = {}
+        statuses = []
+        for name, source in (("sql", self.sql), ("kv", self.kv),
+                             ("pubsub", self.pubsub), ("tpu", self.tpu)):
+            if source is None:
+                continue
+            try:
+                h = source.health_check()
+            except Exception as exc:  # noqa: BLE001 - a broken probe is DOWN
+                h = Health(status=STATUS_DOWN, details={"error": str(exc)})
+            details[name] = h.to_dict() if isinstance(h, Health) else h
+            statuses.append(h.status if isinstance(h, Health) else h.get("status", STATUS_DOWN))
+        for name, svc in self.services.items():
+            try:
+                h = svc.health_check()
+            except Exception as exc:  # noqa: BLE001
+                h = Health(status=STATUS_DOWN, details={"error": str(exc)})
+            details.setdefault("services", {})[name] = h.to_dict()
+            statuses.append(h.status)
+        if any(s == STATUS_DOWN for s in statuses):
+            out["status"] = STATUS_DEGRADED
+        out["details"] = details
+        return out
+
+    def close(self) -> None:
+        for source in (self.sql, self.pubsub, self.tpu):
+            if source is not None and hasattr(source, "close"):
+                try:
+                    source.close()
+                except Exception:  # noqa: BLE001
+                    pass
+
+
+def new_mock_container(config: Optional[Dict[str, str]] = None) -> Container:
+    """Fully-faked container for handler unit tests.
+
+    Parity: container/mock_container.go:19-55 — real Container shape, fake infra:
+    in-memory SQL (sqlite :memory:), in-proc KV + broker, capturing logger.
+    """
+    cfg = MockConfig(dict(config or {}))
+    c = Container(cfg, logger=MockLogger(level=Level.DEBUG))
+    c.metrics_manager = MetricsManager(logger=c.logger)
+    c.register_framework_metrics()
+    c.tracer = Tracer(service_name="test")
+
+    from ..datasource.kvstore import KVStore
+    from ..datasource.sql import SQL
+    from ..pubsub.inproc import InProcBroker
+
+    c.sql = SQL(MockConfig({"DB_PATH": ":memory:"}), c.logger, c.metrics_manager)
+    c.kv = KVStore(cfg, c.logger, c.metrics_manager)
+    c.pubsub = InProcBroker(cfg, c.logger, c.metrics_manager)
+    return c

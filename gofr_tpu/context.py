@@ -1,0 +1,112 @@
+"""Per-request Context: request + container + span, handed to every handler.
+
+Parity: reference pkg/gofr/context.go:12-71 — Context embeds the stdlib
+context (here: plain attributes + deadline), the transport Request, and the
+*Container; `Trace(name)` opens a child span (:45-51); `Bind` delegates to the
+request (:53-55). Handlers access datasources as ctx.sql / ctx.kv / ctx.tpu
+and the logger methods directly (ctx.info/debug/error...), mirroring how the
+reference embeds Logger in Container.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Optional
+
+
+class Context:
+    def __init__(self, request: Any, container: Any, responder: Any = None,
+                 deadline: Optional[float] = None):
+        self.request = request
+        self.container = container
+        self.responder = responder
+        self.deadline = deadline
+        self.span = getattr(request, "span", None)
+
+    # -- request passthrough --------------------------------------------------
+    def param(self, key: str) -> str:
+        return self.request.param(key)
+
+    def params(self, key: str):
+        return self.request.params(key)
+
+    def path_param(self, key: str) -> str:
+        return self.request.path_param(key)
+
+    def bind(self, target: Any = None) -> Any:
+        return self.request.bind(target)
+
+    def header(self, key: str) -> str:
+        getter = getattr(self.request, "header", None)
+        return getter(key) if getter else ""
+
+    def host_name(self) -> str:
+        return self.request.host_name()
+
+    # -- deadline (stdlib-context analog) -------------------------------------
+    def done(self) -> bool:
+        return self.deadline is not None and time.time() >= self.deadline
+
+    def remaining(self) -> Optional[float]:
+        if self.deadline is None:
+            return None
+        return max(0.0, self.deadline - time.time())
+
+    # -- tracing (context.go:45-51) -------------------------------------------
+    def trace(self, name: str):
+        tracer = self.container.tracer
+        if tracer is None:
+            from .tracing import Tracer
+            tracer = Tracer()
+        span = tracer.start_span(name, parent=self.span)
+        return span
+
+    # -- container passthrough ------------------------------------------------
+    @property
+    def sql(self):
+        return self.container.sql
+
+    @property
+    def kv(self):
+        return self.container.kv
+
+    @property
+    def tpu(self):
+        return self.container.tpu
+
+    @property
+    def pubsub(self):
+        return self.container.pubsub
+
+    @property
+    def config(self):
+        return self.container.config
+
+    @property
+    def logger(self):
+        return self.container.logger
+
+    def metrics(self):
+        return self.container.metrics()
+
+    def get_http_service(self, name: str):
+        return self.container.get_http_service(name)
+
+    def publish(self, topic: str, message: Any) -> None:
+        import json
+
+        pub = self.container.get_publisher()
+        if pub is None:
+            raise RuntimeError("no pub/sub backend configured (set PUBSUB_BACKEND)")
+        if isinstance(message, (dict, list)):
+            message = json.dumps(message).encode()
+        elif isinstance(message, str):
+            message = message.encode()
+        pub.publish(topic, message)
+
+    # -- logger passthrough ---------------------------------------------------
+    def __getattr__(self, name: str):
+        logger = self.__dict__.get("container").logger
+        if hasattr(logger, name):
+            return getattr(logger, name)
+        raise AttributeError(name)

@@ -1,0 +1,110 @@
+"""Declarative CRUD generator: register REST handlers from a dataclass entity.
+
+Parity: reference pkg/gofr/crud_handlers.go — scanEntity (first field is the
+primary key, :53-70), registerCRUDHandlers adding POST/GET/GET-by-id/PUT/DELETE
+(:73-103), default SQL implementations via the query builder (:105-244), and
+per-verb override by defining the matching method on the entity class
+(create/get_all/get/update/delete — the interface checks at :17-43).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from .context import Context
+from .datasource import sql as sqlbuilder
+from .http.errors import EntityNotFound, HTTPError
+
+
+def _table_name(entity_cls: type) -> str:
+    name = entity_cls.__name__
+    # CamelCase -> snake_case, same normalisation the reference applies
+    out = []
+    for i, ch in enumerate(name):
+        if ch.isupper() and i > 0:
+            out.append("_")
+        out.append(ch.lower())
+    return "".join(out)
+
+
+def scan_entity(entity_cls: type):
+    if not dataclasses.is_dataclass(entity_cls):
+        raise TypeError("add_rest_handlers requires a dataclass entity")
+    fields = dataclasses.fields(entity_cls)
+    if not fields:
+        raise TypeError("entity has no fields")
+    return _table_name(entity_cls), fields[0].name, [f.name for f in fields]
+
+
+def register_crud_handlers(app, entity_cls: type, table: Optional[str] = None) -> None:
+    default_table, pk, columns = scan_entity(entity_cls)
+    table = table or default_table
+    base = f"/{table.replace('_', '-')}"
+    pk_type = dataclasses.fields(entity_cls)[0].type
+
+    def coerce_id(ident: str):
+        if pk_type in (int, "int"):
+            try:
+                return int(ident)
+            except ValueError as exc:
+                raise HTTPError(f"invalid id {ident!r}", 400) from exc
+        return ident
+
+    def ensure_table(ctx: Context) -> None:
+        cols = ", ".join(f"{c} PRIMARY KEY" if c == pk else c for c in columns)
+        ctx.sql.exec(f"CREATE TABLE IF NOT EXISTS {table} ({cols})")
+
+    def create(ctx: Context):
+        if hasattr(entity_cls, "create"):
+            return entity_cls.create(ctx)
+        ensure_table(ctx)
+        entity = ctx.bind(entity_cls)
+        values = [getattr(entity, c) for c in columns]
+        ctx.sql.exec(sqlbuilder.insert_query(table, columns), *values)
+        return f"{entity_cls.__name__} successfully created with id: {getattr(entity, pk)}"
+
+    def get_all(ctx: Context):
+        if hasattr(entity_cls, "get_all"):
+            return entity_cls.get_all(ctx)
+        ensure_table(ctx)
+        return ctx.sql.select(entity_cls, sqlbuilder.select_all_query(table))
+
+    def get_one(ctx: Context):
+        if hasattr(entity_cls, "get"):
+            return entity_cls.get(ctx)
+        ensure_table(ctx)
+        ident = coerce_id(ctx.path_param("id"))
+        rows = ctx.sql.select(entity_cls, sqlbuilder.select_by_query(table, pk), ident)
+        if not rows:
+            raise EntityNotFound(pk, ident)
+        return rows[0]
+
+    def update(ctx: Context):
+        if hasattr(entity_cls, "update"):
+            return entity_cls.update(ctx)
+        ensure_table(ctx)
+        ident = coerce_id(ctx.path_param("id"))
+        entity = ctx.bind(entity_cls)
+        non_pk = [c for c in columns if c != pk]
+        values = [getattr(entity, c) for c in non_pk] + [ident]
+        cur = ctx.sql.exec(sqlbuilder.update_by_query(table, non_pk, pk), *values)
+        if cur.rowcount == 0:
+            raise EntityNotFound(pk, ident)
+        return f"{entity_cls.__name__} successfully updated with id: {ident}"
+
+    def delete(ctx: Context):
+        if hasattr(entity_cls, "delete"):
+            return entity_cls.delete(ctx)
+        ensure_table(ctx)
+        ident = coerce_id(ctx.path_param("id"))
+        cur = ctx.sql.exec(sqlbuilder.delete_by_query(table, pk), ident)
+        if cur.rowcount == 0:
+            raise EntityNotFound(pk, ident)
+        return f"{entity_cls.__name__} successfully deleted with id: {ident}"
+
+    app.post(base, create)
+    app.get(base, get_all)
+    app.get(base + "/{id}", get_one)
+    app.put(base + "/{id}", update)
+    app.delete(base + "/{id}", delete)

@@ -1,0 +1,195 @@
+"""SQL datasource: sqlite3-backed, with query logging/metrics, a dialect-aware
+query builder, transactions, reflection select, and health.
+
+Parity: reference pkg/gofr/datasource/sql/ — DB wrapper logging+timing every
+query into app_sql_stats (db.go:47-66), Tx wrapper (db.go:102-130), reflection
+Select into structs via `db` tags (db.go:201-299 -> here dataclass fields),
+query builder (query_builder.go:8-67, bindvars bind.go:24-52), health with pool
+stats (health.go:26-65). The reference dials mysql/postgres over TCP; in this
+zero-egress environment the bundled dialect is sqlite (DB_DIALECT=sqlite),
+with the same interface so other dialects can be registered.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import sqlite3
+import threading
+import time
+from typing import Any, Iterable, List, Optional, Sequence, Type
+
+from ..logging import PrettyPrint
+from . import Health, STATUS_DOWN, STATUS_UP
+
+
+class QueryLog(PrettyPrint):
+    """Structured SQL log record (sql/db.go:30-38)."""
+
+    def __init__(self, query: str, duration_us: int, args_count: int):
+        self.query = query
+        self.duration_us = duration_us
+        self.args_count = args_count
+
+    def pretty_print(self, fp) -> None:
+        fp.write(f"\x1b[36mSQL\x1b[0m {self.duration_us:>8}µs {self.query}")
+
+
+class SQL:
+    """Connection wrapper. sqlite serializes writes; a lock keeps one writer."""
+
+    def __init__(self, config, logger, metrics):
+        self.logger = logger
+        self.metrics = metrics
+        self.dialect = config.get_or_default("DB_DIALECT", "sqlite")
+        self.path = config.get_or_default("DB_PATH", config.get_or_default("DB_NAME", ":memory:"))
+        self._lock = threading.RLock()
+        self._conn: Optional[sqlite3.Connection] = None
+        self._connected_at: Optional[float] = None
+        self._query_count = 0
+        self._connect()
+
+    def _connect(self) -> None:
+        try:
+            self._conn = sqlite3.connect(self.path, check_same_thread=False)
+            self._conn.row_factory = sqlite3.Row
+            self._connected_at = time.time()
+            self.logger.infof("connected to %s database at %s", self.dialect, self.path)
+        except sqlite3.Error as exc:
+            # boot must survive a bad datasource config (sql/sql.go:33-36)
+            self.logger.errorf("could not connect to database: %s", exc)
+            self._conn = None
+
+    def _observe(self, query: str, start: float, args: Sequence[Any]) -> None:
+        elapsed = time.time() - start
+        self._query_count += 1
+        if self.metrics is not None:
+            stmt = query.strip().split(" ", 1)[0].upper() if query.strip() else "?"
+            self.metrics.record_histogram("app_sql_stats", elapsed, type=stmt)
+        self.logger.debug(QueryLog(query, int(elapsed * 1e6), len(args)))
+
+    # -- query API ------------------------------------------------------------
+    def exec(self, query: str, *args: Any) -> sqlite3.Cursor:
+        start = time.time()
+        with self._lock:
+            cur = self._conn.execute(query, args)
+            self._conn.commit()
+        self._observe(query, start, args)
+        return cur
+
+    def query(self, query: str, *args: Any) -> List[sqlite3.Row]:
+        start = time.time()
+        with self._lock:
+            rows = self._conn.execute(query, args).fetchall()
+        self._observe(query, start, args)
+        return rows
+
+    def query_row(self, query: str, *args: Any) -> Optional[sqlite3.Row]:
+        rows = self.query(query, *args)
+        return rows[0] if rows else None
+
+    def select(self, target_type: Type, query: str, *args: Any) -> List[Any]:
+        """Reflection select: rows -> list of `target_type` (dataclass or dict)."""
+        rows = self.query(query, *args)
+        if target_type is dict:
+            return [dict(r) for r in rows]
+        if dataclasses.is_dataclass(target_type):
+            names = {f.name for f in dataclasses.fields(target_type)}
+            return [target_type(**{k: r[k] for k in r.keys() if k in names}) for r in rows]
+        raise TypeError("select target must be dict or a dataclass type")
+
+    def begin(self) -> "Tx":
+        return Tx(self)
+
+    # -- health ---------------------------------------------------------------
+    def health_check(self) -> Health:
+        if self._conn is None:
+            return Health(status=STATUS_DOWN, details={"dialect": self.dialect, "path": self.path})
+        try:
+            with self._lock:
+                self._conn.execute("SELECT 1")
+            return Health(status=STATUS_UP, details={
+                "dialect": self.dialect, "path": self.path,
+                "queries": self._query_count,
+                "uptime_s": round(time.time() - (self._connected_at or time.time()), 1),
+            })
+        except sqlite3.Error as exc:
+            return Health(status=STATUS_DOWN, details={"error": str(exc)})
+
+    def close(self) -> None:
+        with self._lock:
+            if self._conn is not None:
+                self._conn.close()
+                self._conn = None
+
+
+class Tx:
+    """Explicit transaction (sql/db.go:102-130). Commit or rollback exactly once."""
+
+    def __init__(self, db: SQL):
+        self.db = db
+        self.db._lock.acquire()
+        try:
+            if self.db._conn is None:
+                raise sqlite3.OperationalError("database is not connected")
+            self.db._conn.execute("BEGIN")
+        except BaseException:
+            self.db._lock.release()
+            raise
+        self._done = False
+
+    def exec(self, query: str, *args: Any) -> sqlite3.Cursor:
+        start = time.time()
+        cur = self.db._conn.execute(query, args)
+        self.db._observe(query, start, args)
+        return cur
+
+    def query(self, query: str, *args: Any) -> List[sqlite3.Row]:
+        start = time.time()
+        rows = self.db._conn.execute(query, args).fetchall()
+        self.db._observe(query, start, args)
+        return rows
+
+    def commit(self) -> None:
+        if not self._done:
+            self.db._conn.commit()
+            self._done = True
+            self.db._lock.release()
+
+    def rollback(self) -> None:
+        if not self._done:
+            self.db._conn.rollback()
+            self._done = True
+            self.db._lock.release()
+
+    def __enter__(self) -> "Tx":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if exc is not None:
+            self.rollback()
+        else:
+            self.commit()
+
+
+# -- dialect-aware query builder (backs the CRUD generator) -------------------
+def insert_query(table: str, columns: Iterable[str]) -> str:
+    cols = list(columns)
+    placeholders = ", ".join(["?"] * len(cols))
+    return f"INSERT INTO {table} ({', '.join(cols)}) VALUES ({placeholders})"
+
+
+def select_all_query(table: str) -> str:
+    return f"SELECT * FROM {table}"
+
+
+def select_by_query(table: str, key: str) -> str:
+    return f"SELECT * FROM {table} WHERE {key} = ?"
+
+
+def update_by_query(table: str, columns: Iterable[str], key: str) -> str:
+    sets = ", ".join(f"{c} = ?" for c in columns)
+    return f"UPDATE {table} SET {sets} WHERE {key} = ?"
+
+
+def delete_by_query(table: str, key: str) -> str:
+    return f"DELETE FROM {table} WHERE {key} = ?"

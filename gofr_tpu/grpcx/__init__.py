@@ -1,0 +1,173 @@
+"""gRPC server with logging + recovery + tracing interceptors.
+
+Parity: reference pkg/gofr/grpc.go:20-46 (grpc.Server on GRPC_PORT, started
+only when a service is registered) and pkg/gofr/grpc/log.go:58-94 (interceptor
+opening a span and emitting an RPCLog per call).
+
+protoc's Python gRPC plugin is not available in this environment, so services
+register via `GenericService`: a (service_name, {method: handler}) pair using
+pluggable serializers (default JSON bytes). Handlers receive a Context whose
+request carries the deserialized message — the same handler shape as HTTP.
+Stubs generated elsewhere also work: any object exposing
+`__grpc_service_name__` and `__grpc_methods__` registers identically.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from concurrent import futures
+from typing import Any, Callable, Dict, Optional
+
+from ..context import Context
+from ..logging import PrettyPrint
+
+
+class RPCLog(PrettyPrint):
+    def __init__(self, method: str, status: str, duration_us: int, trace_id: str = ""):
+        self.method = method
+        self.status = status
+        self.response_time_us = duration_us
+        self.trace_id = trace_id
+
+    def pretty_print(self, fp) -> None:
+        fp.write(f"{self.trace_id} \x1b[34mRPC\x1b[0m {self.status} "
+                 f"{self.response_time_us:>8}µs {self.method}")
+
+
+class GRPCRequest:
+    """Adapts a deserialized gRPC message to the framework Request interface."""
+
+    def __init__(self, payload: Any, method: str, metadata: Dict[str, str]):
+        self.payload = payload
+        self.method = method
+        self.metadata = metadata
+        self.span = None
+        self.context: Dict[str, Any] = {}
+
+    def param(self, key: str) -> str:
+        if isinstance(self.payload, dict):
+            return str(self.payload.get(key, ""))
+        return ""
+
+    def path_param(self, key: str) -> str:
+        return self.method if key == "method" else ""
+
+    def host_name(self) -> str:
+        return "grpc://" + self.metadata.get(":authority", "")
+
+    def bind(self, target: Any = None) -> Any:
+        import dataclasses
+
+        data = self.payload
+        if target is None:
+            return data
+        if isinstance(target, type) and dataclasses.is_dataclass(target):
+            names = {f.name for f in dataclasses.fields(target)}
+            return target(**{k: v for k, v in data.items() if k in names})
+        if isinstance(target, dict):
+            target.update(data)
+            return target
+        for k, v in data.items():
+            setattr(target, k, v)
+        return target
+
+
+class GenericService:
+    def __init__(self, name: str, methods: Dict[str, Callable[[Context], Any]],
+                 serializer: Optional[Callable[[Any], bytes]] = None,
+                 deserializer: Optional[Callable[[bytes], Any]] = None):
+        self.__grpc_service_name__ = name
+        self.__grpc_methods__ = methods
+        self.serializer = serializer or (lambda obj: json.dumps(obj, default=str).encode())
+        self.deserializer = deserializer or (lambda raw: json.loads(raw.decode()) if raw else {})
+
+
+class GRPCServer:
+    def __init__(self, container, port: int, logger):
+        import grpc
+
+        self.container = container
+        self.port = port
+        self.logger = logger
+        self._grpc = grpc
+        self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=32))
+
+    def register(self, service) -> None:
+        grpc = self._grpc
+        name = service.__grpc_service_name__
+        methods = service.__grpc_methods__
+        serializer = getattr(service, "serializer", lambda o: json.dumps(o, default=str).encode())
+        deserializer = getattr(service, "deserializer", lambda raw: json.loads(raw.decode()) if raw else {})
+
+        handlers = {}
+        for method_name, fn in methods.items():
+            handlers[method_name] = grpc.unary_unary_rpc_method_handler(
+                self._adapt(f"/{name}/{method_name}", fn, serializer),
+                request_deserializer=deserializer,
+                response_serializer=lambda b: b,
+            )
+        self._server.add_generic_rpc_handlers(
+            (grpc.method_handlers_generic_handler(name, handlers),))
+
+    def _adapt(self, full_method: str, fn, serializer):
+        def handle(payload, grpc_ctx):
+            start = time.time()
+            metadata = {k: v for k, v in (grpc_ctx.invocation_metadata() or [])}
+            request = GRPCRequest(payload, full_method, metadata)
+            span = None
+            if self.container.tracer is not None:
+                span = self.container.tracer.start_span(
+                    f"grpc {full_method}", traceparent=metadata.get("traceparent"))
+                request.span = span
+            ctx = Context(request=request, container=self.container)
+            status = "OK"
+            try:
+                result = fn(ctx)
+                return serializer(result)
+            except Exception as exc:  # noqa: BLE001 - recovery interceptor (grpc.go:23-25)
+                status = "ERROR"
+                self.logger.errorf("grpc handler %s failed: %s", full_method, exc)
+                grpc_ctx.abort(self._grpc.StatusCode.INTERNAL, str(exc))
+            finally:
+                duration_us = int((time.time() - start) * 1e6)
+                trace_id = span.trace_id if span else ""
+                self.logger.info(RPCLog(full_method, status, duration_us, trace_id))
+                if span is not None:
+                    span.set_status(status == "OK")
+                    span.end()
+
+        return handle
+
+    def start(self) -> None:
+        bound = self._server.add_insecure_port(f"0.0.0.0:{self.port}")
+        if self.port == 0:
+            self.port = bound
+        self._server.start()
+        self.logger.infof("gRPC server started on port %d", self.port)
+
+    def stop(self) -> None:
+        self._server.stop(grace=0.5)
+
+
+class GRPCClient:
+    """Counterpart client for GenericService endpoints (JSON-over-gRPC)."""
+
+    def __init__(self, address: str):
+        import grpc
+
+        self._grpc = grpc
+        self.channel = grpc.insecure_channel(address)
+
+    def call(self, service: str, method: str, payload: Any, timeout_s: float = 5.0,
+             metadata: Optional[Dict[str, str]] = None) -> Any:
+        fn = self.channel.unary_unary(
+            f"/{service}/{method}",
+            request_serializer=lambda obj: json.dumps(obj, default=str).encode(),
+            response_deserializer=lambda raw: json.loads(raw.decode()) if raw else None,
+        )
+        md = list((metadata or {}).items())
+        return fn(payload, timeout=timeout_s, metadata=md)
+
+    def close(self) -> None:
+        self.channel.close()

@@ -1,0 +1,16 @@
+"""HTTP transport layer: router, request/responder, middleware, server."""
+
+from .errors import (EntityAlreadyExists, EntityNotFound, HTTPError, InvalidParam,
+                     InvalidRoute, MissingParam, PanicRecovery, RequestTimeout,
+                     ServiceUnavailable)
+from .request import Request
+from .responder import File, Raw, Redirect, Responder, Response, Stream
+from .router import Router
+from .server import HTTPServer
+
+__all__ = [
+    "EntityAlreadyExists", "EntityNotFound", "HTTPError", "InvalidParam",
+    "InvalidRoute", "MissingParam", "PanicRecovery", "RequestTimeout",
+    "ServiceUnavailable", "Request", "File", "Raw", "Redirect", "Responder",
+    "Response", "Stream", "Router", "HTTPServer",
+]

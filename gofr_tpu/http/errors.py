@@ -1,0 +1,93 @@
+"""HTTP error types mapped to status codes by the Responder.
+
+Parity: reference pkg/gofr/http/responder.go:53-74 (HTTPStatusFromError) and the
+error types under pkg/gofr/http (ErrorMissingParam, ErrorInvalidParam,
+ErrorEntityNotFound, ErrorEntityAlreadyExist, ErrorInvalidRoute,
+ErrorRequestTimeout, ErrorPanicRecovery).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+
+class HTTPError(Exception):
+    status_code = 500
+
+    def __init__(self, message: str = "", status_code: int | None = None):
+        super().__init__(message or self.__class__.__name__)
+        self.message = message or str(self)
+        if status_code is not None:
+            self.status_code = status_code
+
+
+class MissingParam(HTTPError):
+    status_code = 400
+
+    def __init__(self, params: Sequence[str] = ()):
+        self.params = list(params)
+        super().__init__(f"Parameter(s) {','.join(self.params)} required for this request")
+
+
+class InvalidParam(HTTPError):
+    status_code = 400
+
+    def __init__(self, params: Sequence[str] = ()):
+        self.params = list(params)
+        super().__init__(f"Incorrect value for parameter(s): {','.join(self.params)}")
+
+
+class EntityNotFound(HTTPError):
+    status_code = 404
+
+    def __init__(self, name: str = "entity", value: str = ""):
+        super().__init__(f"No entity found with {name}: {value}")
+
+
+class EntityAlreadyExists(HTTPError):
+    status_code = 409
+
+    def __init__(self, message: str = "entity already exists"):
+        super().__init__(message)
+
+
+class InvalidRoute(HTTPError):
+    status_code = 404
+
+    def __init__(self):
+        super().__init__("route not registered")
+
+
+class RequestTimeout(HTTPError):
+    status_code = 408
+
+    def __init__(self):
+        super().__init__("request timed out")
+
+
+class PanicRecovery(HTTPError):
+    status_code = 500
+
+    def __init__(self):
+        super().__init__("some unexpected error has occurred")
+
+
+class ServiceUnavailable(HTTPError):
+    status_code = 503
+
+    def __init__(self, message: str = "service unavailable"):
+        super().__init__(message)
+
+
+def status_from_error(err: BaseException, method: str) -> int:
+    if isinstance(err, HTTPError):
+        return err.status_code
+    return 500
+
+
+def status_from_method(method: str) -> int:
+    if method == "POST":
+        return 201
+    if method == "DELETE":
+        return 204
+    return 200

@@ -1,0 +1,258 @@
+"""Default middleware chain: Tracer -> Logging -> CORS -> Metrics, plus auth.
+
+Parity: reference pkg/gofr/http/middleware/ — tracer.go:15-32 (extract W3C
+traceparent, span per request), logger.go:69-150 (status-capturing request log
++ panic recovery -> 500), cors.go:6-22, metrics.go:21-42 (app_http_response
+histogram by path/method/status), basic_auth.go:18-72, apikey_auth.go:11-57,
+oauth.go:53-140 (JWT w/ background JWKS refresh -> here HMAC/static-key JWT),
+validate.go:5-7 (/.well-known bypass for auth).
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import json
+import time
+from typing import Callable, Iterable, Optional
+
+from ...logging import PrettyPrint
+from ..errors import PanicRecovery
+from ..request import Request
+from ..responder import Response
+from ..router import WireHandler
+
+WELL_KNOWN_PREFIX = "/.well-known/"
+
+
+def _is_well_known(request: Request) -> bool:
+    return request.path.startswith(WELL_KNOWN_PREFIX)
+
+
+# -- tracing ------------------------------------------------------------------
+def tracer_middleware(tracer) -> Callable[[WireHandler], WireHandler]:
+    def mw(inner: WireHandler) -> WireHandler:
+        def handle(request: Request) -> Response:
+            span = tracer.start_span(
+                f"{request.method} {request.path}",
+                traceparent=request.headers.get("traceparent"),
+            )
+            span.set_attribute("http.method", request.method)
+            span.set_attribute("http.target", request.path)
+            request.span = span
+            try:
+                resp = inner(request)
+                span.set_attribute("http.status_code", resp.status)
+                span.set_status(resp.status < 500)
+                resp.headers.setdefault("X-Trace-Id", span.trace_id)
+                return resp
+            finally:
+                span.end()
+
+        return handle
+
+    return mw
+
+
+# -- request logging + panic recovery ----------------------------------------
+class RequestLog(PrettyPrint):
+    """Structured request log record (middleware/logger.go:27-42)."""
+
+    def __init__(self, trace_id: str, method: str, uri: str, status: int, duration_us: int, ip: str):
+        self.trace_id = trace_id
+        self.method = method
+        self.uri = uri
+        self.status = status
+        self.response_time_us = duration_us
+        self.ip = ip
+
+    def pretty_print(self, fp) -> None:
+        color = 32 if self.status < 400 else (33 if self.status < 500 else 31)
+        fp.write(f"{self.trace_id} \x1b[{color}m{self.status}\x1b[0m "
+                 f"{self.response_time_us:>8}µs {self.method} {self.uri}")
+
+
+def logging_middleware(logger) -> Callable[[WireHandler], WireHandler]:
+    def mw(inner: WireHandler) -> WireHandler:
+        def handle(request: Request) -> Response:
+            start = time.time()
+            try:
+                resp = inner(request)
+            except Exception as exc:  # noqa: BLE001 - panic recovery -> 500
+                logger.error({"error": str(exc), "path": request.path,
+                              "method": request.method, "panic": True})
+                err = PanicRecovery()
+                resp = Response(status=err.status_code,
+                                headers={"Content-Type": "application/json"},
+                                body=json.dumps({"error": {"message": err.message}}).encode())
+            duration_us = int((time.time() - start) * 1e6)
+            trace_id = request.span.trace_id if request.span is not None else ""
+            record = RequestLog(trace_id, request.method, request.path, resp.status,
+                                duration_us, request.client_addr)
+            if resp.status >= 500:
+                logger.error(record)
+            else:
+                logger.info(record)
+            return resp
+
+        return handle
+
+    return mw
+
+
+# -- CORS ---------------------------------------------------------------------
+def cors_middleware(allowed_headers: str = "Authorization, Content-Type, x-requested-with, origin, true-client-ip, X-Correlation-ID",
+                    allowed_methods: str = "PUT, POST, GET, DELETE, OPTIONS, PATCH") -> Callable[[WireHandler], WireHandler]:
+    def mw(inner: WireHandler) -> WireHandler:
+        def handle(request: Request) -> Response:
+            if request.method == "OPTIONS":
+                resp = Response(status=200)
+            else:
+                resp = inner(request)
+            resp.headers.setdefault("Access-Control-Allow-Origin", "*")
+            resp.headers.setdefault("Access-Control-Allow-Headers", allowed_headers)
+            resp.headers.setdefault("Access-Control-Allow-Methods", allowed_methods)
+            return resp
+
+        return handle
+
+    return mw
+
+
+# -- metrics ------------------------------------------------------------------
+def metrics_middleware(metrics) -> Callable[[WireHandler], WireHandler]:
+    def mw(inner: WireHandler) -> WireHandler:
+        def handle(request: Request) -> Response:
+            start = time.time()
+            resp = inner(request)
+            # label by the matched route template, not the raw path, to bound
+            # series cardinality (the reference labels by mux route the same way)
+            route = getattr(request, "route_pattern", None) or "unmatched"
+            metrics.record_histogram("app_http_response", time.time() - start,
+                                     path=route, method=request.method,
+                                     status=str(resp.status))
+            return resp
+
+        return handle
+
+    return mw
+
+
+# -- auth ---------------------------------------------------------------------
+def _unauthorized(message: str = "Unauthorized") -> Response:
+    return Response(status=401, headers={"Content-Type": "application/json",
+                                         "WWW-Authenticate": "Basic"},
+                    body=json.dumps({"error": {"message": message}}).encode())
+
+
+def basic_auth_middleware(users: dict, validate_func: Optional[Callable[[str, str], bool]] = None):
+    """users: {username: password}. Optional custom validator like the reference's
+    EnableBasicAuthWithFunc (basic_auth.go:34-55)."""
+
+    def mw(inner: WireHandler) -> WireHandler:
+        def handle(request: Request) -> Response:
+            if _is_well_known(request):
+                return inner(request)
+            header = request.headers.get("authorization", "")
+            if not header.startswith("Basic "):
+                return _unauthorized()
+            try:
+                decoded = base64.b64decode(header[6:]).decode("utf-8")
+                user, _, password = decoded.partition(":")
+            except Exception:  # noqa: BLE001
+                return _unauthorized()
+            if validate_func is not None:
+                ok = validate_func(user, password)
+            else:
+                expected = users.get(user)
+                ok = expected is not None and hmac.compare_digest(expected, password)
+            if not ok:
+                return _unauthorized()
+            request.auth_subject = user
+            return inner(request)
+
+        return handle
+
+    return mw
+
+
+def api_key_auth_middleware(keys: Iterable[str] = (), validate_func: Optional[Callable[[str], bool]] = None):
+    keyset = set(keys)
+
+    def mw(inner: WireHandler) -> WireHandler:
+        def handle(request: Request) -> Response:
+            if _is_well_known(request):
+                return inner(request)
+            key = request.headers.get("x-api-key", "")
+            if not key:
+                return _unauthorized()
+            ok = validate_func(key) if validate_func is not None else key in keyset
+            if not ok:
+                return _unauthorized()
+            request.auth_subject = "api-key"
+            return inner(request)
+
+        return handle
+
+    return mw
+
+
+# -- JWT (HS256) --------------------------------------------------------------
+def _b64url_decode(data: str) -> bytes:
+    pad = "=" * (-len(data) % 4)
+    return base64.urlsafe_b64decode(data + pad)
+
+
+def _b64url_encode(data: bytes) -> str:
+    return base64.urlsafe_b64encode(data).rstrip(b"=").decode()
+
+
+def jwt_encode(claims: dict, secret: str) -> str:
+    header = _b64url_encode(json.dumps({"alg": "HS256", "typ": "JWT"}).encode())
+    payload = _b64url_encode(json.dumps(claims).encode())
+    signing = f"{header}.{payload}".encode()
+    sig = hmac.new(secret.encode(), signing, hashlib.sha256).digest()
+    return f"{header}.{payload}.{_b64url_encode(sig)}"
+
+
+def jwt_decode(token: str, secret: str) -> Optional[dict]:
+    parts = token.split(".")
+    if len(parts) != 3:
+        return None
+    signing = f"{parts[0]}.{parts[1]}".encode()
+    expected = hmac.new(secret.encode(), signing, hashlib.sha256).digest()
+    try:
+        if not hmac.compare_digest(expected, _b64url_decode(parts[2])):
+            return None
+        claims = json.loads(_b64url_decode(parts[1]))
+    except Exception:  # noqa: BLE001
+        return None
+    exp = claims.get("exp")
+    if exp is not None and time.time() > float(exp):
+        return None
+    return claims
+
+
+def oauth_middleware(secret: str):
+    """Bearer-JWT validation. The reference refreshes RSA JWKS in the background
+    (oauth.go:53-140); with zero egress we validate HS256 against a shared
+    secret, keeping the same claim checks (exp) and claim propagation."""
+
+    def mw(inner: WireHandler) -> WireHandler:
+        def handle(request: Request) -> Response:
+            if _is_well_known(request):
+                return inner(request)
+            header = request.headers.get("authorization", "")
+            if not header.startswith("Bearer "):
+                return _unauthorized()
+            claims = jwt_decode(header[7:], secret)
+            if claims is None:
+                return _unauthorized("invalid or expired token")
+            request.auth_subject = str(claims.get("sub", ""))
+            request.context["jwt_claims"] = claims
+            return inner(request)
+
+        return handle
+
+    return mw

@@ -1,0 +1,127 @@
+"""HTTP Request wrapper: params, path params, JSON/multipart bind.
+
+Parity: reference pkg/gofr/http/request.go:34-121 (NewRequest, Param/PathParam
+via mux.Vars, Bind JSON or multipart with a 32 MB cap) and
+pkg/gofr/request.go:8-15 (the transport-agnostic Request interface:
+Context, Param, PathParam, Bind, HostName).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Dict, List, Optional
+from urllib.parse import parse_qs, urlsplit
+
+from .errors import HTTPError
+
+MAX_BODY_BYTES = 32 << 20  # request.go:18
+
+
+class BindError(HTTPError):
+    status_code = 400
+
+
+class Request:
+    """One inbound HTTP request. Instances are built by the server glue and
+    enriched by the router (path_params) and middleware (span)."""
+
+    def __init__(
+        self,
+        method: str,
+        target: str,
+        headers: Optional[Dict[str, str]] = None,
+        body: bytes = b"",
+        client_addr: str = "",
+    ):
+        self.method = method.upper()
+        split = urlsplit(target)
+        self.path = split.path or "/"
+        self.query: Dict[str, List[str]] = parse_qs(split.query, keep_blank_values=True)
+        self.headers = {k.lower(): v for k, v in (headers or {}).items()}
+        self.body = body or b""
+        self.client_addr = client_addr
+        self.path_params: Dict[str, str] = {}
+        self.route_pattern: Optional[str] = None  # set by the router on match
+        self.span = None  # set by tracer middleware
+        self.auth_subject: Optional[str] = None  # set by auth middleware
+        self.context: Dict[str, Any] = {}  # request-scoped values
+
+    # -- reference Request interface -----------------------------------------
+    def param(self, key: str) -> str:
+        vals = self.query.get(key)
+        return vals[0] if vals else ""
+
+    def params(self, key: str) -> List[str]:
+        return list(self.query.get(key, []))
+
+    def path_param(self, key: str) -> str:
+        return self.path_params.get(key, "")
+
+    def host_name(self) -> str:
+        proto = self.headers.get("x-forwarded-proto", "http")
+        return f"{proto}://{self.headers.get('host', '')}"
+
+    def header(self, key: str) -> str:
+        return self.headers.get(key.lower(), "")
+
+    def bind(self, target: Any = None) -> Any:
+        """Decode the body into `target`.
+
+        - no target: returns parsed JSON (dict/list/scalar)
+        - a dataclass type: instantiates it from the JSON object's fields
+        - a dict instance: updated in place
+        - any other instance: JSON object keys set as attributes
+        Content-Type multipart/form-data binds form fields instead (file parts
+        exposed as bytes), mirroring bindMultipart (request.go:97-121).
+        """
+        if len(self.body) > MAX_BODY_BYTES:
+            raise BindError("request body exceeds 32 MB limit")
+        ctype = self.headers.get("content-type", "")
+        if ctype.startswith("multipart/form-data"):
+            data = self._parse_multipart(ctype)
+        else:
+            try:
+                data = json.loads(self.body.decode("utf-8")) if self.body else {}
+            except (json.JSONDecodeError, UnicodeDecodeError) as exc:
+                raise BindError(f"invalid JSON body: {exc}") from exc
+
+        if target is None:
+            return data
+        if isinstance(target, type) and dataclasses.is_dataclass(target):
+            if not isinstance(data, dict):
+                raise BindError("JSON object required to bind a dataclass")
+            field_names = {f.name for f in dataclasses.fields(target)}
+            try:
+                return target(**{k: v for k, v in data.items() if k in field_names})
+            except TypeError as exc:
+                raise BindError(f"missing or invalid fields: {exc}") from exc
+        if isinstance(target, dict):
+            if not isinstance(data, dict):
+                raise BindError("JSON object required to bind a dict")
+            target.update(data)
+            return target
+        if not isinstance(data, dict):
+            raise BindError("JSON object required to bind an object")
+        for k, v in data.items():
+            setattr(target, k, v)
+        return target
+
+    def _parse_multipart(self, ctype: str) -> Dict[str, Any]:
+        import email.parser
+        import email.policy
+
+        raw = b"Content-Type: " + ctype.encode() + b"\r\n\r\n" + self.body
+        msg = email.parser.BytesParser(policy=email.policy.default).parsebytes(raw)
+        out: Dict[str, Any] = {}
+        for part in msg.iter_parts():
+            name = part.get_param("name", header="content-disposition")
+            if not name:
+                continue
+            filename = part.get_filename()
+            payload = part.get_payload(decode=True)
+            if filename:
+                out[name] = {"filename": filename, "content": payload}
+            else:
+                out[name] = payload.decode("utf-8", "replace") if payload else ""
+        return out

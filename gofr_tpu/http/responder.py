@@ -1,0 +1,133 @@
+"""Response types + the uniform JSON-envelope Responder.
+
+Parity: reference pkg/gofr/http/responder.go:24-74 (Respond -> {data}/{error}
+envelope, status from method POST->201 DELETE->204 and from error type) and
+pkg/gofr/http/response/{raw.go,file.go} passthrough types.
+
+TPU-era extension (SURVEY.md §7.5): `Stream` — a generator-backed chunked or
+SSE response used by /generate token streaming. The reference's Raw/File
+passthrough (responder.go:29-37) is the hook this generalises.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Callable, Dict, Iterable, Iterator, Optional
+
+from .errors import HTTPError, status_from_method
+
+
+class Response:
+    """Wire-level response handed to the server glue."""
+
+    def __init__(self, status: int = 200, headers: Optional[Dict[str, str]] = None,
+                 body: bytes = b"", stream: Optional[Iterator[bytes]] = None):
+        self.status = status
+        self.headers = headers or {}
+        self.body = body
+        self.stream = stream  # when set, body is ignored and chunks are flushed as produced
+
+
+# -- passthrough result types a handler may return ---------------------------
+class Raw:
+    """Marshal `data` as JSON without the {data: ...} envelope (response/raw.go:3-5)."""
+
+    def __init__(self, data: Any):
+        self.data = data
+
+
+class File:
+    """Raw bytes with a content type (response/file.go:3-6)."""
+
+    def __init__(self, content: bytes, content_type: str = "application/octet-stream",
+                 status: int = 200):
+        self.content = content
+        self.content_type = content_type
+        self.status = status
+
+
+class Redirect:
+    def __init__(self, url: str, status: int = 302):
+        self.url = url
+        self.status = status
+
+
+class Stream:
+    """Generator-backed streaming body. `sse=True` wraps each chunk as a
+    `data: ...\n\n` server-sent event (the /generate token stream)."""
+
+    def __init__(self, chunks: Iterable[Any], content_type: str = "application/octet-stream",
+                 sse: bool = False, on_close: Optional[Callable[[], None]] = None):
+        self.chunks = chunks
+        self.sse = sse
+        self.content_type = "text/event-stream" if sse else content_type
+        self.on_close = on_close
+
+    def iter_bytes(self) -> Iterator[bytes]:
+        try:
+            for chunk in self.chunks:
+                if self.sse:
+                    if not isinstance(chunk, (str, bytes)):
+                        chunk = json.dumps(chunk, default=str)
+                    if isinstance(chunk, bytes):
+                        chunk = chunk.decode("utf-8", "replace")
+                    yield f"data: {chunk}\n\n".encode()
+                else:
+                    if isinstance(chunk, str):
+                        chunk = chunk.encode()
+                    elif not isinstance(chunk, bytes):
+                        chunk = json.dumps(chunk, default=str).encode()
+                    yield chunk
+        finally:
+            if self.on_close is not None:
+                self.on_close()
+
+
+class Responder:
+    """Builds the uniform envelope; one per request (created by the handler adapter)."""
+
+    def __init__(self, method: str):
+        self.method = method
+
+    def respond(self, data: Any, err: Optional[BaseException]) -> Response:
+        if err is not None:
+            status = err.status_code if isinstance(err, HTTPError) else 500
+            payload = {"error": {"message": getattr(err, "message", None) or str(err)}}
+            return self._json(status, payload)
+
+        if isinstance(data, Response):
+            return data
+        if isinstance(data, Raw):
+            return self._json(status_from_method(self.method), data.data)
+        if isinstance(data, File):
+            return Response(status=data.status, headers={"Content-Type": data.content_type},
+                            body=data.content)
+        if isinstance(data, Redirect):
+            return Response(status=data.status, headers={"Location": data.url})
+        if isinstance(data, Stream):
+            return Response(status=200, headers={"Content-Type": data.content_type},
+                            stream=data.iter_bytes())
+
+        status = status_from_method(self.method)
+        if status == 204:
+            return Response(status=204)
+        return self._json(status, {"data": data})
+
+    @staticmethod
+    def _json(status: int, payload: Any) -> Response:
+        body = json.dumps(payload, default=_json_default).encode()
+        return Response(status=status, headers={"Content-Type": "application/json"}, body=body)
+
+
+def _json_default(obj: Any) -> Any:
+    import dataclasses
+
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        return dataclasses.asdict(obj)
+    if hasattr(obj, "tolist"):  # numpy / jax arrays
+        return obj.tolist()
+    if hasattr(obj, "item"):
+        return obj.item()
+    if hasattr(obj, "__dict__"):
+        return {k: v for k, v in vars(obj).items() if not k.startswith("_")}
+    return str(obj)

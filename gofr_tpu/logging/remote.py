@@ -1,0 +1,64 @@
+"""Remote log-level updater: polls a URL and applies level changes live.
+
+Parity: reference pkg/gofr/logging/remotelogger/dynamicLevelLogger.go:23-106
+(poll REMOTE_LOG_URL every REMOTE_LOG_FETCH_INTERVAL seconds, parse the level
+from the JSON body, call ChangeLevel). Accepted response shapes:
+`{"data": [{"serviceName": ..., "logLevel": {"LOG_LEVEL": "DEBUG"}}]}` (the
+reference's), `{"data": {"LOG_LEVEL": "DEBUG"}}`, or a bare `"DEBUG"` string.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Optional
+
+from . import Logger, parse_level
+
+
+def _extract_level(payload) -> Optional[str]:
+    if isinstance(payload, str):
+        return payload
+    if isinstance(payload, dict):
+        data = payload.get("data", payload)
+        if isinstance(data, list) and data:
+            data = data[0]
+        if isinstance(data, dict):
+            lvl = data.get("logLevel", data)
+            if isinstance(lvl, dict):
+                return lvl.get("LOG_LEVEL")
+            if isinstance(lvl, str):
+                return lvl
+            return data.get("LOG_LEVEL")
+    return None
+
+
+def fetch_and_update_level(logger: Logger, url: str) -> None:
+    try:
+        import requests
+
+        resp = requests.get(url, timeout=3)
+        if resp.status_code != 200:
+            return
+        name = _extract_level(json.loads(resp.text))
+        if not name:
+            return
+        new_level = parse_level(name, logger.level)
+        if new_level != logger.level:
+            logger.infof("LOG_LEVEL updated from %s to %s", logger.level.name, new_level.name)
+            logger.change_level(new_level)
+    except Exception:  # noqa: BLE001 - remote logging must never break the app
+        pass
+
+
+def start_remote_level_updater(logger: Logger, url: str, interval_s: float = 15.0) -> threading.Thread:
+    def loop() -> None:
+        import time
+
+        while True:
+            fetch_and_update_level(logger, url)
+            time.sleep(interval_s)
+
+    t = threading.Thread(target=loop, name="remote-log-level", daemon=True)
+    t.start()
+    return t

@@ -1,0 +1,224 @@
+"""Name-keyed metrics registry with Prometheus text exposition.
+
+Parity: reference pkg/gofr/metrics/register.go:15-25 (8-method Manager:
+new_counter/new_updown_counter/new_histogram/new_gauge + typed record calls),
+metrics/store.go:16-26 (name-keyed store, duplicate/missing-name errors in
+metrics/errors.go), metrics/exporters/exporter.go (Prometheus exposition).
+
+TPU-era additions (SURVEY.md §5): tokens/sec, TTFT/TPOT histograms, batch-size
+gauge, HBM bytes, queue depth, compile-cache hits are registered by the
+container/TPU client on top of this Manager.
+"""
+
+from __future__ import annotations
+
+import bisect
+import math
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+LabelKey = Tuple[Tuple[str, str], ...]
+
+
+def _label_key(labels: Dict[str, str]) -> LabelKey:
+    return tuple(sorted(labels.items()))
+
+
+class MetricError(Exception):
+    pass
+
+
+class DuplicateMetric(MetricError):
+    def __init__(self, name: str):
+        super().__init__(f"metric {name} already registered")
+
+
+class MetricNotFound(MetricError):
+    def __init__(self, name: str):
+        super().__init__(f"metric {name} not registered")
+
+
+class _Instrument:
+    kind = "untyped"
+
+    def __init__(self, name: str, desc: str):
+        self.name = name
+        self.desc = desc
+        self.series: Dict[LabelKey, object] = {}
+        self.lock = threading.Lock()
+
+    def expose(self) -> List[str]:  # pragma: no cover - interface
+        raise NotImplementedError
+
+    def _header(self) -> List[str]:
+        return [f"# HELP {self.name} {self.desc}", f"# TYPE {self.name} {self.kind}"]
+
+
+def _escape_label(value: str) -> str:
+    return str(value).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _fmt_labels(key: LabelKey) -> str:
+    if not key:
+        return ""
+    inner = ",".join(f'{k}="{_escape_label(v)}"' for k, v in key)
+    return "{" + inner + "}"
+
+
+class Counter(_Instrument):
+    kind = "counter"
+
+    def add(self, value: float = 1.0, **labels: str) -> None:
+        key = _label_key(labels)
+        with self.lock:
+            self.series[key] = float(self.series.get(key, 0.0)) + value  # type: ignore[arg-type]
+
+    def expose(self) -> List[str]:
+        out = self._header()
+        for key, val in sorted(self.series.items()):
+            out.append(f"{self.name}{_fmt_labels(key)} {val}")
+        return out
+
+
+class UpDownCounter(Counter):
+    kind = "gauge"  # prometheus has no updown counter; exposed as gauge
+
+
+class Gauge(_Instrument):
+    kind = "gauge"
+
+    def set(self, value: float, **labels: str) -> None:
+        with self.lock:
+            self.series[_label_key(labels)] = float(value)
+
+    def expose(self) -> List[str]:
+        out = self._header()
+        for key, val in sorted(self.series.items()):
+            out.append(f"{self.name}{_fmt_labels(key)} {val}")
+        return out
+
+
+DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30)
+
+
+class Histogram(_Instrument):
+    kind = "histogram"
+
+    def __init__(self, name: str, desc: str, buckets: Sequence[float] = DEFAULT_BUCKETS):
+        super().__init__(name, desc)
+        self.buckets = sorted(buckets)
+
+    def record(self, value: float, **labels: str) -> None:
+        key = _label_key(labels)
+        with self.lock:
+            entry = self.series.get(key)
+            if entry is None:
+                entry = {"counts": [0] * (len(self.buckets) + 1), "sum": 0.0, "count": 0}
+                self.series[key] = entry
+            idx = bisect.bisect_left(self.buckets, value)
+            entry["counts"][idx] += 1  # type: ignore[index]
+            entry["sum"] += value  # type: ignore[operator]
+            entry["count"] += 1  # type: ignore[operator]
+
+    def percentile(self, q: float, **labels: str) -> float:
+        """Approximate percentile from bucket midpoints (for tests/health, not SLO math)."""
+        key = _label_key(labels)
+        entry = self.series.get(key)
+        if not entry:
+            return math.nan
+        target = q * entry["count"]  # type: ignore[index]
+        cum = 0
+        for i, c in enumerate(entry["counts"]):  # type: ignore[index]
+            cum += c
+            if cum >= target:
+                return self.buckets[i] if i < len(self.buckets) else self.buckets[-1]
+        return self.buckets[-1]
+
+    def expose(self) -> List[str]:
+        out = self._header()
+        for key, entry in sorted(self.series.items()):
+            cum = 0
+            for i, bound in enumerate(self.buckets):
+                cum += entry["counts"][i]  # type: ignore[index]
+                lk = dict(key)
+                lk["le"] = repr(bound) if isinstance(bound, float) else str(bound)
+                out.append(f"{self.name}_bucket{_fmt_labels(_label_key(lk))} {cum}")
+            cum += entry["counts"][-1]  # type: ignore[index]
+            lk = dict(key)
+            lk["le"] = "+Inf"
+            out.append(f"{self.name}_bucket{_fmt_labels(_label_key(lk))} {cum}")
+            out.append(f"{self.name}_sum{_fmt_labels(key)} {entry['sum']}")  # type: ignore[index]
+            out.append(f"{self.name}_count{_fmt_labels(key)} {entry['count']}")  # type: ignore[index]
+        return out
+
+
+class Manager:
+    """The 8-method metrics manager handed to user handlers via ctx.metrics()."""
+
+    def __init__(self, logger=None):
+        self._store: Dict[str, _Instrument] = {}
+        self._lock = threading.Lock()
+        self._logger = logger
+
+    def _register(self, inst: _Instrument) -> None:
+        with self._lock:
+            if inst.name in self._store:
+                err = DuplicateMetric(inst.name)
+                if self._logger is not None:
+                    self._logger.error(str(err))
+                    return
+                raise err
+            self._store[inst.name] = inst
+
+    def _get(self, name: str, kind: type) -> _Instrument:
+        inst = self._store.get(name)
+        if inst is None or not isinstance(inst, kind):
+            err = MetricNotFound(name)
+            if self._logger is not None:
+                self._logger.error(str(err))
+                return kind(name, "unregistered")  # inert throwaway
+            raise err
+        return inst
+
+    # -- registration --------------------------------------------------------
+    def new_counter(self, name: str, desc: str) -> None:
+        self._register(Counter(name, desc))
+
+    def new_updown_counter(self, name: str, desc: str) -> None:
+        self._register(UpDownCounter(name, desc))
+
+    def new_gauge(self, name: str, desc: str) -> None:
+        self._register(Gauge(name, desc))
+
+    def new_histogram(self, name: str, desc: str, buckets: Sequence[float] = DEFAULT_BUCKETS) -> None:
+        self._register(Histogram(name, desc, buckets))
+
+    # -- recording -----------------------------------------------------------
+    def increment_counter(self, name: str, value: float = 1.0, **labels: str) -> None:
+        self._get(name, Counter).add(value, **labels)  # type: ignore[attr-defined]
+
+    def delta_updown_counter(self, name: str, value: float, **labels: str) -> None:
+        self._get(name, UpDownCounter).add(value, **labels)  # type: ignore[attr-defined]
+
+    def set_gauge(self, name: str, value: float, **labels: str) -> None:
+        self._get(name, Gauge).set(value, **labels)  # type: ignore[attr-defined]
+
+    def record_histogram(self, name: str, value: float, **labels: str) -> None:
+        self._get(name, Histogram).record(value, **labels)  # type: ignore[attr-defined]
+
+    # -- introspection -------------------------------------------------------
+    def get(self, name: str) -> Optional[_Instrument]:
+        return self._store.get(name)
+
+    def expose(self) -> str:
+        """Render the whole registry in Prometheus text exposition format."""
+        lines: List[str] = []
+        with self._lock:
+            instruments = list(self._store.values())
+        for inst in sorted(instruments, key=lambda i: i.name):
+            lines.extend(inst.expose())
+        return "\n".join(lines) + "\n"
+
+
+def new_metrics_manager(logger=None) -> Manager:
+    return Manager(logger=logger)

@@ -1,0 +1,102 @@
+"""Versioned migrations over SQL + KV with a persisted watermark and rollback.
+
+Parity: reference pkg/gofr/migration/ — `Run(map[int64]Migrate, container)`
+validating and sorting versions (migration.go:18-79), chain-of-responsibility
+Migrator built from live datasources (migration.go:98-126, datasource.go:20-26),
+SQL `gofr_migrations` table + per-migration transaction (sql.go:13-26,87-133),
+KV `gofr_migrations` hash via pipeline (redis.go:70-135), pub/sub topic ops as
+migration steps (pubsub.go:5-24), rollback on failure.
+
+TPU-era use (SURVEY.md §5 checkpoint/resume): model-artifact upgrades
+(weights manifest / compiled-program versions) ride this same ordered,
+watermarked mechanism.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict
+
+MIGRATION_TABLE = "gofr_migrations"
+
+
+class Datasource:
+    """What a migration function receives: the writable handles."""
+
+    def __init__(self, container, tx=None):
+        self.sql = tx if tx is not None else container.sql
+        self.kv = container.kv
+        self.pubsub = container.pubsub
+        self.logger = container.logger
+        self.tpu = container.tpu
+
+
+class MigrationError(Exception):
+    pass
+
+
+def _ensure_table(sql) -> None:
+    sql.exec(f"""CREATE TABLE IF NOT EXISTS {MIGRATION_TABLE} (
+        version INTEGER PRIMARY KEY,
+        method TEXT,
+        start_time TEXT,
+        duration_ms INTEGER)""")
+
+
+def _last_sql_version(sql) -> int:
+    row = sql.query_row(f"SELECT MAX(version) AS v FROM {MIGRATION_TABLE}")
+    return int(row["v"]) if row and row["v"] is not None else 0
+
+
+def _last_kv_version(kv) -> int:
+    if kv is None:
+        return 0
+    data = kv.hgetall(MIGRATION_TABLE)
+    return max((int(v) for v in data.keys()), default=0)
+
+
+def run(migrations: Dict[int, Callable], container) -> None:
+    """Apply pending migrations in version order; each runs in a SQL Tx and is
+    recorded in the watermark table/hash only on success."""
+    if not migrations:
+        return
+    for version in migrations:
+        if not isinstance(version, int) or version <= 0:
+            raise MigrationError(f"invalid migration version {version!r}")
+        if not callable(migrations[version]):
+            raise MigrationError(f"migration {version} is not callable")
+
+    logger = container.logger
+    sql, kv = container.sql, container.kv
+    if sql is None and kv is None:
+        logger.warn("no datasource available; skipping migrations")
+        return
+    if sql is not None:
+        _ensure_table(sql)
+
+    last = max(_last_sql_version(sql) if sql is not None else 0, _last_kv_version(kv))
+
+    for version in sorted(migrations):
+        if version <= last:
+            continue
+        start = time.time()
+        tx = sql.begin() if sql is not None else None
+        ds = Datasource(container, tx=tx)
+        try:
+            migrations[version](ds)
+            duration_ms = int((time.time() - start) * 1e3)
+            if tx is not None:
+                tx.exec(f"INSERT INTO {MIGRATION_TABLE} (version, method, start_time, duration_ms)"
+                        f" VALUES (?, ?, ?, ?)",
+                        version, "UP", time.strftime("%Y-%m-%dT%H:%M:%S"), duration_ms)
+                tx.commit()
+            if kv is not None:
+                kv.hset(MIGRATION_TABLE, str(version), {
+                    "method": "UP", "startTime": time.strftime("%Y-%m-%dT%H:%M:%S"),
+                    "duration_ms": duration_ms})
+            logger.infof("migration %d ran successfully in %dms", version, duration_ms)
+        except Exception as exc:
+            if tx is not None:
+                tx.rollback()
+            logger.errorf("migration %d failed: %s", version, exc)
+            raise MigrationError(f"migration {version} failed: {exc}") from exc

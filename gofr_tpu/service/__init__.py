@@ -1,0 +1,303 @@
+"""Outbound HTTP service client: tracing, metrics, health, circuit breaker, auth.
+
+Parity: reference pkg/gofr/service/ — NewHTTPService with decorator-chain
+Options (new.go:68-87), every request traced + logged + histogrammed into
+app_http_service_response (new.go:135-192), health polling of
+/.well-known/alive (health.go:18-50, custom endpoint health_config.go:5-23),
+circuit breaker with failure threshold, open state, and periodic health-probe
+recovery (circuit_breaker.go:24-214), auth decorators: basic (basic_auth.go),
+API key (apikey_auth.go), OAuth2 client-credentials (oauth.go), default
+headers (custom_header.go).
+
+The same breaker wraps the TPU scheduler (SURVEY.md §3.4 TPU equivalent).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from ..datasource import Health, STATUS_DOWN, STATUS_UP
+
+
+class ServiceResponse:
+    def __init__(self, status_code: int, body: bytes, headers: Optional[Dict[str, str]] = None):
+        self.status_code = status_code
+        self.body = body
+        self.headers = headers or {}
+
+    def json(self) -> Any:
+        return json.loads(self.body.decode("utf-8")) if self.body else None
+
+
+class CircuitOpenError(Exception):
+    def __init__(self):
+        super().__init__("circuit breaker is open; service unreachable")
+
+
+class HTTPService:
+    """Plain client; decorators wrap it."""
+
+    def __init__(self, address: str, logger=None, metrics=None, timeout_s: float = 5.0):
+        self.address = address.rstrip("/")
+        self.logger = logger
+        self.metrics = metrics
+        self.timeout_s = timeout_s
+        self.health_endpoint = ".well-known/alive"
+        self.default_headers: Dict[str, str] = {}
+
+    # -- verb surface (new.go:26-33) ------------------------------------------
+    def get(self, ctx, path: str, params: Optional[Dict[str, Any]] = None,
+            headers: Optional[Dict[str, str]] = None) -> ServiceResponse:
+        return self.request(ctx, "GET", path, params=params, headers=headers)
+
+    def post(self, ctx, path: str, params: Optional[Dict[str, Any]] = None,
+             body: Any = None, headers: Optional[Dict[str, str]] = None) -> ServiceResponse:
+        return self.request(ctx, "POST", path, params=params, body=body, headers=headers)
+
+    def put(self, ctx, path: str, params=None, body=None, headers=None) -> ServiceResponse:
+        return self.request(ctx, "PUT", path, params=params, body=body, headers=headers)
+
+    def patch(self, ctx, path: str, params=None, body=None, headers=None) -> ServiceResponse:
+        return self.request(ctx, "PATCH", path, params=params, body=body, headers=headers)
+
+    def delete(self, ctx, path: str, body=None, headers=None) -> ServiceResponse:
+        return self.request(ctx, "DELETE", path, body=body, headers=headers)
+
+    def request(self, ctx, method: str, path: str, params=None, body=None,
+                headers=None) -> ServiceResponse:
+        import requests
+
+        url = f"{self.address}/{path.lstrip('/')}"
+        allheaders = dict(self.default_headers)
+        if headers:
+            allheaders.update(headers)
+
+        span = None
+        if ctx is not None and getattr(ctx, "span", None) is not None:
+            span = ctx.trace(f"http-service {method} {url}")
+            allheaders["traceparent"] = span.traceparent
+
+        data = None
+        if body is not None:
+            if isinstance(body, (dict, list)):
+                data = json.dumps(body).encode()
+                allheaders.setdefault("Content-Type", "application/json")
+            elif isinstance(body, str):
+                data = body.encode()
+            else:
+                data = body
+
+        start = time.time()
+        try:
+            resp = requests.request(method, url, params=params, data=data,
+                                    headers=allheaders, timeout=self.timeout_s)
+            status, content = resp.status_code, resp.content
+            resp_headers = dict(resp.headers)
+        finally:
+            elapsed = time.time() - start
+            if self.metrics is not None:
+                self.metrics.record_histogram("app_http_service_response", elapsed,
+                                              path=url, method=method)
+            if span is not None:
+                span.end()
+            if self.logger is not None:
+                self.logger.debugf("http service %s %s took %dµs", method, url,
+                                   int(elapsed * 1e6))
+        return ServiceResponse(status, content, resp_headers)
+
+    def health_check(self) -> Health:
+        try:
+            resp = self.request(None, "GET", self.health_endpoint)
+            if resp.status_code < 500:
+                return Health(status=STATUS_UP, details={"host": self.address})
+            return Health(status=STATUS_DOWN,
+                          details={"host": self.address, "status_code": resp.status_code})
+        except Exception as exc:  # noqa: BLE001 - unreachable is DOWN, not a crash
+            return Health(status=STATUS_DOWN, details={"host": self.address, "error": str(exc)})
+
+
+# -- options (decorators) -----------------------------------------------------
+class Options:
+    def apply(self, svc: HTTPService) -> HTTPService:  # pragma: no cover - interface
+        raise NotImplementedError
+
+
+class DefaultHeaders(Options):
+    def __init__(self, **headers: str):
+        self.headers = headers
+
+    def apply(self, svc: HTTPService) -> HTTPService:
+        svc.default_headers.update(self.headers)
+        return svc
+
+
+class BasicAuthConfig(Options):
+    def __init__(self, username: str, password: str):
+        self.username = username
+        self.password = password
+
+    def apply(self, svc: HTTPService) -> HTTPService:
+        token = base64.b64encode(f"{self.username}:{self.password}".encode()).decode()
+        svc.default_headers["Authorization"] = f"Basic {token}"
+        return svc
+
+
+class APIKeyConfig(Options):
+    def __init__(self, api_key: str):
+        self.api_key = api_key
+
+    def apply(self, svc: HTTPService) -> HTTPService:
+        svc.default_headers["X-Api-Key"] = self.api_key
+        return svc
+
+
+class OAuthConfig(Options):
+    """Client-credentials flow: fetches + caches a bearer token (oauth.go:15-68)."""
+
+    def __init__(self, client_id: str, client_secret: str, token_url: str):
+        self.client_id = client_id
+        self.client_secret = client_secret
+        self.token_url = token_url
+        self._token: Optional[str] = None
+        self._expiry = 0.0
+        self._lock = threading.Lock()
+
+    def _fetch(self) -> Optional[str]:
+        import requests
+
+        with self._lock:
+            if self._token and time.time() < self._expiry - 30:
+                return self._token
+            try:
+                resp = requests.post(self.token_url, data={
+                    "grant_type": "client_credentials",
+                    "client_id": self.client_id,
+                    "client_secret": self.client_secret,
+                }, timeout=5)
+                payload = resp.json()
+                self._token = payload.get("access_token")
+                self._expiry = time.time() + float(payload.get("expires_in", 3600))
+            except Exception:  # noqa: BLE001
+                self._token = None
+            return self._token
+
+    def apply(self, svc: HTTPService) -> HTTPService:
+        original = svc.request
+
+        def with_token(ctx, method, path, params=None, body=None, headers=None):
+            token = self._fetch()
+            headers = dict(headers or {})
+            if token:
+                headers["Authorization"] = f"Bearer {token}"
+            return original(ctx, method, path, params=params, body=body, headers=headers)
+
+        svc.request = with_token  # type: ignore[method-assign]
+        return svc
+
+
+class HealthConfig(Options):
+    def __init__(self, endpoint: str):
+        self.endpoint = endpoint
+
+    def apply(self, svc: HTTPService) -> HTTPService:
+        svc.health_endpoint = self.endpoint.lstrip("/")
+        return svc
+
+
+class CircuitBreakerConfig(Options):
+    def __init__(self, threshold: int = 5, interval_s: float = 10.0):
+        self.threshold = threshold
+        self.interval_s = interval_s
+
+    def apply(self, svc: HTTPService) -> "CircuitBreaker":
+        return CircuitBreaker(svc, self.threshold, self.interval_s)
+
+
+class CircuitBreaker:
+    """Counts consecutive failures; opens past threshold; a background prober
+    hits the health endpoint while open and closes on success
+    (circuit_breaker.go:59-120)."""
+
+    def __init__(self, svc: HTTPService, threshold: int, interval_s: float):
+        self._svc = svc
+        self.threshold = threshold
+        self.interval_s = interval_s
+        self.failure_count = 0
+        self.open = False
+        self.opened_at = 0.0
+        self._lock = threading.Lock()
+        self._probe_thread: Optional[threading.Thread] = None
+
+    def __getattr__(self, name):
+        # passthrough for non-verb attributes (address, health_check, ...)
+        return getattr(self._svc, name)
+
+    def _execute(self, fn):
+        with self._lock:
+            if self.open:
+                raise CircuitOpenError()
+        try:
+            result = fn()
+        except Exception:
+            with self._lock:
+                self.failure_count += 1
+                if self.failure_count > self.threshold and not self.open:
+                    self.open = True
+                    self.opened_at = time.time()
+                    self._start_probing()
+            raise
+        with self._lock:
+            self.failure_count = 0
+        return result
+
+    def _start_probing(self) -> None:
+        def probe() -> None:
+            while True:
+                time.sleep(self.interval_s)
+                health = self._svc.health_check()
+                if health.status == STATUS_UP:
+                    with self._lock:
+                        self.open = False
+                        self.failure_count = 0
+                        self._probe_thread = None
+                    return
+
+        self._probe_thread = threading.Thread(target=probe, name="circuit-probe", daemon=True)
+        self._probe_thread.start()
+
+    # verb wrappers (circuit_breaker.go:173-214)
+    def get(self, ctx, path, params=None, headers=None):
+        return self._execute(lambda: self._svc.get(ctx, path, params, headers))
+
+    def post(self, ctx, path, params=None, body=None, headers=None):
+        return self._execute(lambda: self._svc.post(ctx, path, params, body, headers))
+
+    def put(self, ctx, path, params=None, body=None, headers=None):
+        return self._execute(lambda: self._svc.put(ctx, path, params, body, headers))
+
+    def patch(self, ctx, path, params=None, body=None, headers=None):
+        return self._execute(lambda: self._svc.patch(ctx, path, params, body, headers))
+
+    def delete(self, ctx, path, body=None, headers=None):
+        return self._execute(lambda: self._svc.delete(ctx, path, body, headers))
+
+    def request(self, ctx, method, path, **kwargs):
+        return self._execute(lambda: self._svc.request(ctx, method, path, **kwargs))
+
+    def health_check(self) -> Health:
+        with self._lock:
+            if self.open:
+                return Health(status=STATUS_DOWN,
+                              details={"host": self._svc.address, "circuit": "open"})
+        return self._svc.health_check()
+
+
+def new_http_service(address: str, logger=None, metrics=None, *options: Options):
+    svc: Any = HTTPService(address, logger, metrics)
+    for opt in options:
+        svc = opt.apply(svc)
+    return svc

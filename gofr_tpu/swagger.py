@@ -1,0 +1,60 @@
+"""Swagger/OpenAPI routes: serve ./static/openapi.json + a minimal UI.
+
+Parity: reference pkg/gofr/swagger.go:22-55 — OpenAPIHandler serves the spec at
+/.well-known/openapi.json and SwaggerUIHandler serves an embedded UI at
+/.well-known/swagger; routes auto-registered when the spec file exists
+(gofr.go:140-144). The reference embeds the swagger-ui dist; with zero egress
+this build ships a small self-contained HTML viewer instead.
+"""
+
+from __future__ import annotations
+
+import json
+
+from .http.errors import EntityNotFound
+from .http.responder import File
+
+_UI_TEMPLATE = """<!DOCTYPE html>
+<html><head><title>API docs</title><style>
+body{font-family:monospace;margin:2rem;background:#fafafa}
+h1{font-size:1.3rem} .op{margin:.6rem 0;padding:.6rem;background:#fff;border:1px solid #ddd;border-radius:4px}
+.m{display:inline-block;min-width:4.5rem;font-weight:bold}
+.GET{color:#0a0}.POST{color:#07c}.PUT{color:#c70}.DELETE{color:#c00}.PATCH{color:#70c}
+pre{background:#f4f4f4;padding:.5rem;overflow:auto}</style></head>
+<body><h1 id="title">OpenAPI</h1><div id="ops"></div>
+<h2>Raw spec</h2><pre id="raw"></pre>
+<script>
+fetch('/.well-known/openapi.json').then(r=>r.json()).then(spec=>{
+  document.getElementById('title').textContent=(spec.info&&spec.info.title)||'OpenAPI';
+  document.getElementById('raw').textContent=JSON.stringify(spec,null,2);
+  const ops=document.getElementById('ops');
+  for(const [path,methods] of Object.entries(spec.paths||{})){
+    for(const [method,op] of Object.entries(methods)){
+      const div=document.createElement('div');div.className='op';
+      div.innerHTML='<span class="m '+method.toUpperCase()+'">'+method.toUpperCase()+
+        '</span> <code>'+path+'</code> — '+((op&&op.summary)||'');
+      ops.appendChild(div);
+    }
+  }
+});
+</script></body></html>"""
+
+
+def openapi_handler(path: str):
+    def handle(ctx):
+        try:
+            with open(path, "rb") as fp:
+                content = fp.read()
+            json.loads(content)  # reject invalid spec instead of serving garbage
+        except (OSError, json.JSONDecodeError) as exc:
+            raise EntityNotFound("openapi spec", str(exc))
+        return File(content, content_type="application/json")
+
+    return handle
+
+
+def swagger_ui_handler():
+    def handle(ctx):
+        return File(_UI_TEMPLATE.encode(), content_type="text/html")
+
+    return handle

@@ -1,0 +1,37 @@
+"""Test helpers: stdout/stderr capture and a custom error type.
+
+Parity: reference pkg/gofr/testutil/os.go:8-36, testutil/error.go:3-9.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import io
+import socket
+from typing import Callable
+
+
+def stdout_output_for_func(fn: Callable[[], None]) -> str:
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        fn()
+    return buf.getvalue()
+
+
+def stderr_output_for_func(fn: Callable[[], None]) -> str:
+    buf = io.StringIO()
+    with contextlib.redirect_stderr(buf):
+        fn()
+    return buf.getvalue()
+
+
+class CustomError(Exception):
+    def __init__(self, message: str):
+        super().__init__(message)
+        self.message = message
+
+
+def get_free_port() -> int:
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]

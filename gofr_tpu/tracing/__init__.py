@@ -1,0 +1,188 @@
+"""Lightweight OTel-shaped tracing: spans, W3C traceparent propagation, exporters.
+
+Parity: reference sets a global TracerProvider + propagator (pkg/gofr/gofr.go:264-314),
+opens a span per HTTP request (http/middleware/tracer.go:15-32), exposes user spans via
+Context.Trace (context.go:45-51), and ships spans through pluggable exporters
+(pkg/gofr/exporter.go:48-124 custom JSON exporter; zipkin/jaeger variants).
+
+TPU-era addition (SURVEY.md §5): device-step spans and trace-id -> batch-id
+correlation so one request's span covers its slot in a fused batch — the TPU
+scheduler calls `span.set_attribute("batch.id", ...)` on admission.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+
+def _rand_hex(nbytes: int) -> str:
+    return "".join(f"{random.getrandbits(8):02x}" for _ in range(nbytes))
+
+
+class Span:
+    def __init__(self, tracer: "Tracer", name: str, trace_id: str, parent_id: Optional[str]):
+        self.tracer = tracer
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = _rand_hex(8)
+        self.parent_id = parent_id
+        self.start_time = time.time()
+        self.end_time: Optional[float] = None
+        self.attributes: Dict[str, Any] = {}
+        self.status_ok = True
+        self.status_message = ""
+
+    def set_attribute(self, key: str, value: Any) -> None:
+        self.attributes[key] = value
+
+    def set_status(self, ok: bool, message: str = "") -> None:
+        self.status_ok = ok
+        self.status_message = message
+
+    def end(self) -> None:
+        if self.end_time is None:
+            self.end_time = time.time()
+            self.tracer._export(self)
+
+    # context-manager sugar used by Context.trace()
+    def __enter__(self) -> "Span":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if exc is not None:
+            self.set_status(False, str(exc))
+        self.end()
+
+    @property
+    def traceparent(self) -> str:
+        return f"00-{self.trace_id}-{self.span_id}-01"
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "name": self.name,
+            "traceId": self.trace_id,
+            "spanId": self.span_id,
+            "parentId": self.parent_id,
+            "startTime": self.start_time,
+            "duration_ms": ((self.end_time or self.start_time) - self.start_time) * 1e3,
+            "attributes": self.attributes,
+            "ok": self.status_ok,
+            "statusMessage": self.status_message,
+        }
+
+
+class Exporter:
+    def export(self, span: Span) -> None:  # pragma: no cover - interface
+        raise NotImplementedError
+
+
+class NoopExporter(Exporter):
+    def export(self, span: Span) -> None:
+        pass
+
+
+class InMemoryExporter(Exporter):
+    """Test exporter; the analog of the reference's span assertions in middleware tests."""
+
+    def __init__(self):
+        self.spans: List[Span] = []
+        self._lock = threading.Lock()
+
+    def export(self, span: Span) -> None:
+        with self._lock:
+            self.spans.append(span)
+
+
+class LogExporter(Exporter):
+    def __init__(self, logger):
+        self.logger = logger
+
+    def export(self, span: Span) -> None:
+        self.logger.debug({"span": span.to_dict()})
+
+
+class HTTPExporter(Exporter):
+    """POSTs finished span batches as JSON, like the reference's custom 'gofr'
+    exporter (exporter.go:48-124). Failures are logged and dropped — tracing
+    must never take the service down."""
+
+    def __init__(self, url: str, logger=None, batch_size: int = 64, flush_interval_s: float = 5.0):
+        self.url = url
+        self.logger = logger
+        self.batch_size = batch_size
+        self.flush_interval_s = flush_interval_s
+        self._buf: List[Dict[str, Any]] = []
+        self._lock = threading.Lock()
+        self._last_flush = time.time()
+
+    def export(self, span: Span) -> None:
+        with self._lock:
+            self._buf.append(span.to_dict())
+            should = len(self._buf) >= self.batch_size or (time.time() - self._last_flush) > self.flush_interval_s
+            if not should:
+                return
+            batch, self._buf = self._buf, []
+            self._last_flush = time.time()
+        try:
+            import requests
+
+            requests.post(self.url, data=json.dumps(batch),
+                          headers={"Content-Type": "application/json"}, timeout=2)
+        except Exception as exc:  # noqa: BLE001 - exporting is best-effort
+            if self.logger is not None:
+                self.logger.debugf("trace export failed: %s", exc)
+
+
+class Tracer:
+    def __init__(self, service_name: str = "gofr-tpu", exporter: Optional[Exporter] = None, sampled: bool = True):
+        self.service_name = service_name
+        self.exporter = exporter or NoopExporter()
+        self.sampled = sampled
+
+    def start_span(self, name: str, parent: Optional[Span] = None,
+                   traceparent: Optional[str] = None) -> Span:
+        trace_id, parent_id = None, None
+        if parent is not None:
+            trace_id, parent_id = parent.trace_id, parent.span_id
+        elif traceparent:
+            parsed = parse_traceparent(traceparent)
+            if parsed:
+                trace_id, parent_id = parsed
+        if trace_id is None:
+            trace_id = _rand_hex(16)
+        return Span(self, name, trace_id, parent_id)
+
+    def _export(self, span: Span) -> None:
+        if self.sampled:
+            try:
+                self.exporter.export(span)
+            except Exception:  # noqa: BLE001
+                pass
+
+
+def parse_traceparent(header: str) -> Optional[tuple]:
+    """Parse a W3C `traceparent` header -> (trace_id, span_id), or None."""
+    parts = header.strip().split("-")
+    if len(parts) != 4 or len(parts[1]) != 32 or len(parts[2]) != 16:
+        return None
+    return parts[1], parts[2]
+
+
+def exporter_from_config(config, logger) -> Exporter:
+    """Select exporter via TRACE_EXPORTER like gofr.go:281-313 selects
+    jaeger/zipkin/gofr. Here: 'log', 'http' (TRACER_URL), 'memory', default noop."""
+    name = (config.get_or_default("TRACE_EXPORTER", "") or "").lower()
+    if name == "log":
+        return LogExporter(logger)
+    if name in ("http", "gofr", "zipkin", "jaeger"):
+        url = config.get_or_default("TRACER_URL", "")
+        if url:
+            return HTTPExporter(url, logger=logger)
+        logger.warn("TRACE_EXPORTER set but TRACER_URL missing; tracing disabled")
+    if name == "memory":
+        return InMemoryExporter()
+    return NoopExporter()

@@ -1,0 +1,6 @@
+"""Framework version, stamped into logs/metrics/traces.
+
+Parity: reference pkg/gofr/version/version.go:3.
+"""
+
+FRAMEWORK = "0.1.0-dev"

@@ -1,0 +1,185 @@
+"""End-to-end App tests: a real server on an ephemeral port, real HTTP calls.
+
+This mirrors the reference's examples-as-integration-tests idiom
+(examples/http-server/main_test.go:21-52 — boot the app, fire requests,
+assert status codes, including the framework's well-known routes).
+"""
+
+import dataclasses
+import json
+import threading
+
+import requests
+
+from gofr_tpu import App, MockConfig, new_mock_container
+from gofr_tpu.container import Container
+from gofr_tpu.http.errors import EntityNotFound
+from gofr_tpu.http.responder import Stream
+
+
+def make_app(extra_config=None):
+    cfg = {"HTTP_PORT": "0", "METRICS_PORT": "0", "APP_NAME": "test-app",
+           "KV_ENABLED": "true", "DB_PATH": ":memory:", "PUBSUB_BACKEND": "inproc"}
+    cfg.update(extra_config or {})
+    from gofr_tpu.logging import Level, MockLogger
+
+    container = Container.create(MockConfig(cfg))
+    container.logger = MockLogger(level=Level.ERROR)
+    return App(container=container)
+
+
+def test_full_request_cycle():
+    app = make_app()
+
+    @app.get("/greet")
+    def greet(ctx):
+        return {"message": f"hello {ctx.param('name')}"}
+
+    @app.post("/echo")
+    def echo(ctx):
+        return ctx.bind()
+
+    app.start()
+    try:
+        base = f"http://127.0.0.1:{app.http_port}"
+        r = requests.get(f"{base}/greet?name=ada")
+        assert r.status_code == 200
+        assert r.json() == {"data": {"message": "hello ada"}}
+        r = requests.post(f"{base}/echo", json={"a": 1})
+        assert r.status_code == 201
+        assert r.json()["data"] == {"a": 1}
+        # well-known framework routes (main_test.go:37-38 parity)
+        assert requests.get(f"{base}/.well-known/alive").json() == {"data": {"status": "UP"}}
+        health = requests.get(f"{base}/.well-known/health").json()["data"]
+        assert health["status"] in ("UP", "DEGRADED")
+        assert "sql" in health["details"] and "kv" in health["details"]
+        assert requests.get(f"{base}/nope").status_code == 404
+        # metrics server exposes prometheus text
+        m = requests.get(f"http://127.0.0.1:{app.metrics_port}/metrics")
+        assert "app_http_response_bucket" in m.text
+        assert "app_info" in m.text
+    finally:
+        app.shutdown()
+
+
+def test_handler_error_mapping_and_timeout():
+    app = make_app({"REQUEST_TIMEOUT": "0.5"})
+
+    @app.get("/missing")
+    def missing(ctx):
+        raise EntityNotFound("id", "1")
+
+    @app.get("/slow")
+    def slow(ctx):
+        import time
+
+        time.sleep(5)
+        return "done"
+
+    app.start()
+    try:
+        base = f"http://127.0.0.1:{app.http_port}"
+        assert requests.get(f"{base}/missing").status_code == 404
+        r = requests.get(f"{base}/slow")  # 408 before the handler finishes (handler.go:65-75)
+        assert r.status_code == 408
+    finally:
+        app.shutdown()
+
+
+def test_streaming_sse():
+    app = make_app()
+
+    @app.get("/stream")
+    def stream(ctx):
+        return Stream(iter(["one", "two", "three"]), sse=True)
+
+    app.start()
+    try:
+        base = f"http://127.0.0.1:{app.http_port}"
+        with requests.get(f"{base}/stream", stream=True) as r:
+            assert r.headers["Content-Type"] == "text/event-stream"
+            events = [line for line in r.iter_lines() if line]
+        assert events == [b"data: one", b"data: two", b"data: three"]
+    finally:
+        app.shutdown()
+
+
+def test_basic_auth_integration():
+    app = make_app()
+    app.enable_basic_auth("user", "pass")
+
+    @app.get("/private")
+    def private(ctx):
+        return "secret"
+
+    app.start()
+    try:
+        base = f"http://127.0.0.1:{app.http_port}"
+        assert requests.get(f"{base}/private").status_code == 401
+        assert requests.get(f"{base}/private", auth=("user", "pass")).status_code == 200
+    finally:
+        app.shutdown()
+
+
+def test_pubsub_roundtrip():
+    app = make_app()
+    received = []
+    done = threading.Event()
+
+    @app.subscribe("orders")
+    def on_order(ctx):
+        received.append(ctx.bind())
+        done.set()
+
+    @app.post("/order")
+    def publish(ctx):
+        ctx.publish("orders", ctx.bind())
+        return "queued"
+
+    app.start()
+    try:
+        base = f"http://127.0.0.1:{app.http_port}"
+        requests.post(f"{base}/order", json={"id": 9})
+        assert done.wait(timeout=5)
+        assert received == [{"id": 9}]
+    finally:
+        app.shutdown()
+
+
+def test_crud_generator():
+    @dataclasses.dataclass
+    class Book:
+        id: int = 0
+        title: str = ""
+
+    app = make_app()
+    app.add_rest_handlers(Book)
+    app.start()
+    try:
+        base = f"http://127.0.0.1:{app.http_port}"
+        r = requests.post(f"{base}/book", json={"id": 1, "title": "dune"})
+        assert r.status_code == 201
+        r = requests.get(f"{base}/book/1")
+        assert r.json()["data"]["title"] == "dune"
+        r = requests.put(f"{base}/book/1", json={"id": 1, "title": "dune2"})
+        assert r.status_code == 200
+        assert requests.get(f"{base}/book").json()["data"] == [{"id": 1, "title": "dune2"}]
+        assert requests.delete(f"{base}/book/1").status_code == 204
+        assert requests.get(f"{base}/book/1").status_code == 404
+    finally:
+        app.shutdown()
+
+
+def test_mock_container_for_handler_unit_tests():
+    """The reference's NewMockContainer idiom: test handlers with fake infra."""
+    from gofr_tpu.context import Context
+    from gofr_tpu.http.request import Request
+
+    container = new_mock_container()
+    container.kv.set("greeting", "hi")
+
+    def handler(ctx):
+        return ctx.kv.get("greeting")
+
+    ctx = Context(request=Request("GET", "/"), container=container)
+    assert handler(ctx) == "hi"

@@ -1,0 +1,49 @@
+import os
+
+from gofr_tpu.config import EnvFile, MockConfig
+
+
+def _write(tmp_path, name, content):
+    path = tmp_path / name
+    path.write_text(content)
+    return path
+
+
+def test_env_file_loads_base(tmp_path):
+    _write(tmp_path, ".env", "APP_NAME=test-app\nHTTP_PORT=8001\n# comment\nQUOTED=\"hi\"\n")
+    cfg = EnvFile(str(tmp_path), environ={})
+    assert cfg.get("APP_NAME") == "test-app"
+    assert cfg.get_int("HTTP_PORT", 0) == 8001
+    assert cfg.get("QUOTED") == "hi"
+    assert cfg.get("MISSING") is None
+    assert cfg.get_or_default("MISSING", "x") == "x"
+
+
+def test_env_file_local_overlay(tmp_path):
+    _write(tmp_path, ".env", "A=base\nB=base\n")
+    _write(tmp_path, ".local.env", "B=local\n")
+    cfg = EnvFile(str(tmp_path), environ={})
+    assert cfg.get("A") == "base"
+    assert cfg.get("B") == "local"
+
+
+def test_env_file_app_env_overlay(tmp_path):
+    _write(tmp_path, ".env", "A=base\n")
+    _write(tmp_path, ".prod.env", "A=prod\n")
+    cfg = EnvFile(str(tmp_path), environ={"APP_ENV": "prod"})
+    assert cfg.get("A") == "prod"
+
+
+def test_process_env_overrides_file(tmp_path):
+    _write(tmp_path, ".env", "A=file\n")
+    cfg = EnvFile(str(tmp_path), environ={"A": "process"})
+    assert cfg.get("A") == "process"
+
+
+def test_typed_getters():
+    cfg = MockConfig({"I": "5", "F": "2.5", "B": "true", "BAD": "xx"})
+    assert cfg.get_int("I", 0) == 5
+    assert cfg.get_int("BAD", 7) == 7
+    assert cfg.get_float("F", 0) == 2.5
+    assert cfg.get_bool("B") is True
+    assert cfg.get_bool("MISSING", True) is True

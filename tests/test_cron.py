@@ -1,0 +1,63 @@
+import time
+
+import pytest
+
+from gofr_tpu.cron import CronParseError, Schedule
+
+
+def t(minute=0, hour=0, mday=1, mon=1, wday_py=0):
+    return time.struct_time((2026, mon, mday, hour, minute, 0, wday_py, 1, -1))
+
+
+def test_wildcards_match_everything():
+    s = Schedule("* * * * *")
+    assert s.matches(t(minute=59, hour=23))
+
+
+def test_exact_fields():
+    s = Schedule("30 14 1 6 *")
+    assert s.matches(t(minute=30, hour=14, mday=1, mon=6))
+    assert not s.matches(t(minute=31, hour=14, mday=1, mon=6))
+
+
+def test_steps_ranges_lists():
+    s = Schedule("*/15 9-17 * * 1,3,5")
+    # python tm_wday: Mon=0 -> cron Mon=1
+    assert s.matches(t(minute=45, hour=9, wday_py=0))     # Monday
+    assert not s.matches(t(minute=46, hour=9, wday_py=0))
+    assert not s.matches(t(minute=45, hour=8, wday_py=0))
+    assert not s.matches(t(minute=45, hour=9, wday_py=1))  # Tuesday
+
+
+def test_sunday_is_zero():
+    s = Schedule("* * * * 0")
+    assert s.matches(t(wday_py=6))  # python Sunday=6 -> cron 0
+
+
+def test_invalid_specs_raise():
+    for bad in ("* * * *", "60 * * * *", "* 24 * * *", "a * * * *",
+                "*/0 * * * *", "5-1 * * * *"):
+        with pytest.raises(CronParseError):
+            Schedule(bad)
+
+
+def test_crontab_runs_due_job(mock_container):
+    from gofr_tpu.cron import Crontab
+
+    crontab = Crontab(mock_container)
+    ran = []
+    crontab.add_job("* * * * *", "always", lambda ctx: ran.append(ctx))
+    crontab._tick(time.localtime())
+    deadline = time.time() + 2
+    while not ran and time.time() < deadline:
+        time.sleep(0.01)
+    assert ran, "due job did not run"
+    # ctx passed to the job is a full Context with the container
+    assert ran[0].container is mock_container
+
+
+def test_crontab_bad_spec_raises(mock_container):
+    from gofr_tpu.cron import Crontab
+
+    with pytest.raises(CronParseError):
+        Crontab(mock_container).add_job("bad spec", "x", lambda ctx: None)

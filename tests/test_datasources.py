@@ -1,0 +1,118 @@
+import dataclasses
+import time
+
+import pytest
+
+from gofr_tpu.config import MockConfig
+from gofr_tpu.datasource.kvstore import KVStore
+from gofr_tpu.datasource.sql import SQL
+from gofr_tpu.logging import MockLogger
+from gofr_tpu.metrics import Manager
+
+
+@pytest.fixture()
+def db():
+    metrics = Manager()
+    metrics.new_histogram("app_sql_stats", "")
+    return SQL(MockConfig({"DB_PATH": ":memory:"}), MockLogger(), metrics)
+
+
+@pytest.fixture()
+def kv():
+    metrics = Manager()
+    metrics.new_histogram("app_kv_stats", "")
+    return KVStore(MockConfig(), MockLogger(), metrics)
+
+
+# -- SQL ----------------------------------------------------------------------
+def test_sql_exec_query_select(db):
+    db.exec("CREATE TABLE t (id INTEGER PRIMARY KEY, name TEXT)")
+    db.exec("INSERT INTO t (id, name) VALUES (?, ?)", 1, "a")
+    db.exec("INSERT INTO t (id, name) VALUES (?, ?)", 2, "b")
+    assert db.query_row("SELECT name FROM t WHERE id = ?", 2)["name"] == "b"
+
+    @dataclasses.dataclass
+    class Row:
+        id: int
+        name: str
+
+    rows = db.select(Row, "SELECT * FROM t ORDER BY id")
+    assert rows == [Row(1, "a"), Row(2, "b")]
+    assert db.select(dict, "SELECT * FROM t")[0]["name"] == "a"
+
+
+def test_sql_transaction_commit_rollback(db):
+    db.exec("CREATE TABLE t (id INTEGER)")
+    with db.begin() as tx:
+        tx.exec("INSERT INTO t VALUES (1)")
+    assert len(db.query("SELECT * FROM t")) == 1
+    try:
+        with db.begin() as tx:
+            tx.exec("INSERT INTO t VALUES (2)")
+            raise RuntimeError("abort")
+    except RuntimeError:
+        pass
+    assert len(db.query("SELECT * FROM t")) == 1  # rolled back
+
+
+def test_sql_health(db):
+    health = db.health_check()
+    assert health.status == "UP"
+    assert health.details["dialect"] == "sqlite"
+
+
+def test_sql_metrics_recorded(db):
+    db.exec("CREATE TABLE t (id INTEGER)")
+    db.query("SELECT * FROM t")
+    text = db.metrics.expose()
+    assert 'type="SELECT"' in text and 'type="CREATE"' in text
+
+
+# -- KV -----------------------------------------------------------------------
+def test_kv_basic_ops(kv):
+    kv.set("a", "1")
+    assert kv.get("a") == "1"
+    assert kv.exists("a")
+    assert kv.delete("a") == 1
+    assert kv.get("a") is None
+    assert kv.incr("n") == 1
+    assert kv.incr("n", 5) == 6
+    assert kv.decr("n") == 5
+
+
+def test_kv_ttl(kv):
+    kv.set("x", "v", ttl_s=0.05)
+    assert kv.get("x") == "v"
+    assert 0 < kv.ttl("x") <= 0.05
+    time.sleep(0.06)
+    assert kv.get("x") is None
+    assert kv.ttl("x") == -2.0
+    kv.set("y", "v")
+    assert kv.ttl("y") == -1.0
+    assert kv.expire("y", 10)
+    assert kv.ttl("y") > 9
+
+
+def test_kv_hashes_and_keys(kv):
+    kv.hset("h", "f1", "v1")
+    kv.hset("h", "f2", "v2")
+    assert kv.hget("h", "f1") == "v1"
+    assert kv.hgetall("h") == {"f1": "v1", "f2": "v2"}
+    kv.set("other", 1)
+    assert sorted(kv.keys("*")) == ["h", "other"]
+    assert kv.keys("h*") == ["h"]
+
+
+def test_kv_pipeline_atomic(kv):
+    pipe = kv.pipeline()
+    pipe.set("a", 1).hset("h", "f", 2).set("b", 3)
+    assert kv.get("a") is None  # not applied yet
+    pipe.exec()
+    assert kv.get("a") == 1 and kv.hget("h", "f") == 2 and kv.get("b") == 3
+
+
+def test_kv_health(kv):
+    kv.set("k", "v")
+    health = kv.health_check()
+    assert health.status == "UP"
+    assert health.details["keys"] == 1

@@ -1,0 +1,138 @@
+import dataclasses
+import json
+
+import pytest
+
+from gofr_tpu.http.errors import EntityNotFound, HTTPError, status_from_method
+from gofr_tpu.http.request import BindError, Request
+from gofr_tpu.http.responder import File, Raw, Responder, Response, Stream
+from gofr_tpu.http.router import Router
+
+
+def make_request(method="GET", target="/", body=b"", headers=None):
+    return Request(method, target, headers=headers or {}, body=body)
+
+
+# -- request ------------------------------------------------------------------
+def test_query_and_path_params():
+    req = make_request(target="/items?x=1&x=2&y=hi")
+    assert req.param("x") == "1"
+    assert req.params("x") == ["1", "2"]
+    assert req.param("missing") == ""
+    req.path_params = {"id": "42"}
+    assert req.path_param("id") == "42"
+
+
+def test_bind_json_dict_and_dataclass():
+    @dataclasses.dataclass
+    class Person:
+        name: str = ""
+        age: int = 0
+
+    body = json.dumps({"name": "ada", "age": 36, "extra": True}).encode()
+    req = make_request("POST", "/p", body=body)
+    assert req.bind()["name"] == "ada"
+    person = req.bind(Person)
+    assert person.name == "ada" and person.age == 36
+
+
+def test_bind_invalid_json():
+    req = make_request("POST", "/p", body=b"{nope")
+    with pytest.raises(BindError):
+        req.bind()
+
+
+def test_bind_multipart():
+    boundary = "XXX"
+    body = (
+        f"--{boundary}\r\nContent-Disposition: form-data; name=\"field\"\r\n\r\nvalue\r\n"
+        f"--{boundary}\r\nContent-Disposition: form-data; name=\"f\"; filename=\"a.txt\"\r\n"
+        f"Content-Type: text/plain\r\n\r\nfilebytes\r\n--{boundary}--\r\n"
+    ).encode()
+    req = make_request("POST", "/u", body=body,
+                       headers={"Content-Type": f"multipart/form-data; boundary={boundary}"})
+    data = req.bind()
+    assert data["field"] == "value"
+    assert data["f"]["filename"] == "a.txt"
+    assert data["f"]["content"] == b"filebytes"
+
+
+# -- responder ----------------------------------------------------------------
+def test_envelope_success_and_status_by_method():
+    resp = Responder("GET").respond({"k": 1}, None)
+    assert resp.status == 200
+    assert json.loads(resp.body) == {"data": {"k": 1}}
+    assert Responder("POST").respond("x", None).status == 201
+    assert Responder("DELETE").respond(None, None).status == 204
+
+
+def test_envelope_error_mapping():
+    resp = Responder("GET").respond(None, EntityNotFound("id", "9"))
+    assert resp.status == 404
+    assert "No entity found" in json.loads(resp.body)["error"]["message"]
+    assert Responder("GET").respond(None, ValueError("x")).status == 500
+    assert Responder("GET").respond(None, HTTPError("teapot", 418)).status == 418
+
+
+def test_raw_and_file_passthrough():
+    resp = Responder("GET").respond(Raw([1, 2]), None)
+    assert json.loads(resp.body) == [1, 2]
+    resp = Responder("GET").respond(File(b"PNG", content_type="image/png"), None)
+    assert resp.body == b"PNG" and resp.headers["Content-Type"] == "image/png"
+
+
+def test_stream_sse():
+    resp = Responder("GET").respond(Stream(iter(["a", {"t": 1}]), sse=True), None)
+    chunks = list(resp.stream)
+    assert chunks[0] == b"data: a\n\n"
+    assert chunks[1] == b'data: {"t": 1}\n\n'
+    assert resp.headers["Content-Type"] == "text/event-stream"
+
+
+def test_status_from_method():
+    assert status_from_method("POST") == 201
+    assert status_from_method("GET") == 200
+
+
+# -- router -------------------------------------------------------------------
+def ok_handler(body=b"ok"):
+    return lambda req: Response(status=200, body=body)
+
+
+def test_router_match_and_path_params():
+    router = Router()
+    router.add("GET", "/users/{id}/posts/{pid}", lambda req: Response(
+        status=200, body=f"{req.path_param('id')}:{req.path_param('pid')}".encode()))
+    resp = router.dispatch(make_request(target="/users/7/posts/9"))
+    assert resp.body == b"7:9"
+
+
+def test_router_404_405():
+    router = Router()
+    router.add("GET", "/a", ok_handler())
+    assert router.dispatch(make_request(target="/missing")).status == 404
+    assert router.dispatch(make_request("POST", "/a")).status == 405
+
+
+def test_router_trailing_slash():
+    router = Router()
+    router.add("GET", "/a", ok_handler())
+    assert router.dispatch(make_request(target="/a/")).status == 200
+
+
+def test_middleware_order_and_wrap():
+    router = Router()
+    calls = []
+
+    def mw(tag):
+        def middleware(inner):
+            def handle(req):
+                calls.append(tag)
+                return inner(req)
+            return handle
+        return middleware
+
+    router.use_middleware(mw("outer"), mw("inner"))
+    router.add("GET", "/x", ok_handler())
+    router.dispatch(make_request(target="/x"))
+    assert calls == ["outer", "inner"]

@@ -1,0 +1,70 @@
+import io
+import json
+
+from gofr_tpu.logging import Level, Logger, MockLogger, parse_level
+from gofr_tpu.logging.remote import _extract_level
+
+
+def test_level_filtering():
+    logger = MockLogger(level=Level.WARN)
+    logger.info("hidden")
+    logger.warn("shown")
+    out = logger.output()
+    assert "hidden" not in out
+    assert "shown" in out
+
+
+def test_json_output_when_not_terminal():
+    buf = io.StringIO()
+    logger = Logger(level=Level.DEBUG, normal_out=buf, error_out=buf, is_terminal=False)
+    logger.infof("hello %s", "world")
+    record = json.loads(buf.getvalue())
+    assert record["level"] == "INFO"
+    assert record["message"] == "hello world"
+
+
+def test_pretty_output_on_terminal():
+    buf = io.StringIO()
+    logger = Logger(level=Level.DEBUG, normal_out=buf, error_out=buf, is_terminal=True)
+    logger.error("boom")
+    assert "\x1b[31m" in buf.getvalue()  # red for ERROR
+
+
+def test_error_routed_to_error_out():
+    normal, err = io.StringIO(), io.StringIO()
+    logger = Logger(level=Level.DEBUG, normal_out=normal, error_out=err, is_terminal=False)
+    logger.info("a")
+    logger.error("b")
+    assert "a" in normal.getvalue() and "b" not in normal.getvalue()
+    assert "b" in err.getvalue()
+
+
+def test_fatal_raises_system_exit():
+    logger = MockLogger()
+    try:
+        logger.fatal("die")
+        raise AssertionError("should have exited")
+    except SystemExit:
+        pass
+
+
+def test_parse_level():
+    assert parse_level("debug") == Level.DEBUG
+    assert parse_level("NOPE", Level.WARN) == Level.WARN
+
+
+def test_change_level():
+    logger = MockLogger(level=Level.INFO)
+    logger.debug("no")
+    logger.change_level(Level.DEBUG)
+    logger.debug("yes")
+    assert "no" not in logger.output()
+    assert "yes" in logger.output()
+
+
+def test_remote_level_extraction_shapes():
+    assert _extract_level("DEBUG") == "DEBUG"
+    assert _extract_level({"data": {"LOG_LEVEL": "WARN"}}) == "WARN"
+    assert _extract_level({"data": [{"serviceName": "x",
+                                     "logLevel": {"LOG_LEVEL": "ERROR"}}]}) == "ERROR"
+    assert _extract_level({"nonsense": 1}) is None

@@ -1,0 +1,72 @@
+import pytest
+
+from gofr_tpu.metrics import DuplicateMetric, Manager, MetricNotFound
+
+
+def test_counter_roundtrip():
+    m = Manager()
+    m.new_counter("hits", "hit count")
+    m.increment_counter("hits")
+    m.increment_counter("hits", 2, path="/a")
+    text = m.expose()
+    assert "# TYPE hits counter" in text
+    assert "hits 1.0" in text
+    assert 'hits{path="/a"} 2.0' in text
+
+
+def test_duplicate_registration_raises():
+    m = Manager()
+    m.new_counter("x", "")
+    with pytest.raises(DuplicateMetric):
+        m.new_counter("x", "")
+
+
+def test_missing_metric_raises():
+    m = Manager()
+    with pytest.raises(MetricNotFound):
+        m.increment_counter("nope")
+
+
+def test_logger_mode_swallows_errors():
+    from gofr_tpu.logging import MockLogger
+
+    logger = MockLogger()
+    m = Manager(logger=logger)
+    m.increment_counter("nope")  # logged, not raised
+    assert "not registered" in logger.output()
+
+
+def test_gauge_and_updown():
+    m = Manager()
+    m.new_gauge("g", "")
+    m.new_updown_counter("u", "")
+    m.set_gauge("g", 42.5)
+    m.delta_updown_counter("u", 3)
+    m.delta_updown_counter("u", -1)
+    text = m.expose()
+    assert "g 42.5" in text
+    assert "u 2.0" in text
+
+
+def test_histogram_buckets_and_summary():
+    m = Manager()
+    m.new_histogram("lat", "latency", buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.5, 5.0, 50.0):
+        m.record_histogram("lat", v)
+    text = m.expose()
+    assert 'lat_bucket{le="0.1"} 1' in text
+    assert 'lat_bucket{le="1.0"} 2' in text
+    assert 'lat_bucket{le="10.0"} 3' in text
+    assert 'lat_bucket{le="+Inf"} 4' in text
+    assert "lat_count 4" in text
+    assert "lat_sum 55.55" in text
+
+
+def test_histogram_percentile():
+    m = Manager()
+    m.new_histogram("p", "", buckets=(1, 2, 4, 8))
+    for v in (0.5, 1.5, 3, 7):
+        m.record_histogram("p", v)
+    hist = m.get("p")
+    assert hist.percentile(0.5) in (1, 2)
+    assert hist.percentile(1.0) == 8

@@ -1,0 +1,112 @@
+import json
+import time
+
+from gofr_tpu.http import middleware as mw
+from gofr_tpu.http.request import Request
+from gofr_tpu.http.responder import Response
+from gofr_tpu.logging import MockLogger
+from gofr_tpu.metrics import Manager
+from gofr_tpu.tracing import InMemoryExporter, Tracer
+
+
+def make_request(method="GET", target="/", headers=None):
+    return Request(method, target, headers=headers or {})
+
+
+def ok(req):
+    return Response(status=200, body=b"ok")
+
+
+def test_tracer_middleware_creates_span_and_propagates():
+    exporter = InMemoryExporter()
+    tracer = Tracer(exporter=exporter)
+    handler = mw.tracer_middleware(tracer)(ok)
+    parent = "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"
+    resp = handler(make_request(headers={"traceparent": parent}))
+    assert resp.status == 200
+    assert len(exporter.spans) == 1
+    span = exporter.spans[0]
+    assert span.trace_id == "ab" * 16  # joined the incoming trace
+    assert span.parent_id == "cd" * 8
+    assert resp.headers["X-Trace-Id"] == span.trace_id
+
+
+def test_logging_middleware_recovers_panic():
+    logger = MockLogger()
+
+    def boom(req):
+        raise RuntimeError("kaboom")
+
+    handler = mw.logging_middleware(logger)(boom)
+    resp = handler(make_request())
+    assert resp.status == 500
+    assert "unexpected error" in json.loads(resp.body)["error"]["message"]
+    assert "kaboom" in logger.output()
+
+
+def test_metrics_middleware_records_histogram():
+    metrics = Manager()
+    metrics.new_histogram("app_http_response", "")
+
+    def matched(req):
+        req.route_pattern = "/x/{id}"  # the router sets this on match
+        return ok(req)
+
+    handler = mw.metrics_middleware(metrics)(matched)
+    handler(make_request(target="/x/123"))
+    handler(make_request(target="/x/456"))
+    text = metrics.expose()
+    # labelled by route template, not raw path -> one series for both requests
+    assert 'method="GET"' in text and 'path="/x/{id}"' in text
+    assert 'app_http_response_count{le=' not in text
+    assert 'app_http_response_count{method="GET",path="/x/{id}",status="200"} 2' in text
+
+    # unmatched requests collapse into a single series
+    handler2 = mw.metrics_middleware(metrics)(ok)
+    handler2(make_request(target="/random/abc"))
+    assert 'path="unmatched"' in metrics.expose()
+
+
+def test_cors_headers_and_options():
+    handler = mw.cors_middleware()(ok)
+    resp = handler(make_request())
+    assert resp.headers["Access-Control-Allow-Origin"] == "*"
+    resp = handler(make_request(method="OPTIONS"))
+    assert resp.status == 200 and resp.body == b""
+
+
+def test_basic_auth():
+    import base64
+
+    handler = mw.basic_auth_middleware({"admin": "secret"})(ok)
+    assert handler(make_request()).status == 401
+    bad = base64.b64encode(b"admin:wrong").decode()
+    assert handler(make_request(headers={"Authorization": f"Basic {bad}"})).status == 401
+    good = base64.b64encode(b"admin:secret").decode()
+    req = make_request(headers={"Authorization": f"Basic {good}"})
+    assert handler(req).status == 200
+    assert req.auth_subject == "admin"
+    # /.well-known bypass (validate.go:5-7)
+    assert handler(make_request(target="/.well-known/health")).status == 200
+
+
+def test_api_key_auth():
+    handler = mw.api_key_auth_middleware(["k1"])(ok)
+    assert handler(make_request()).status == 401
+    assert handler(make_request(headers={"X-API-Key": "nope"})).status == 401
+    assert handler(make_request(headers={"X-API-Key": "k1"})).status == 200
+
+
+def test_jwt_roundtrip_and_oauth_middleware():
+    token = mw.jwt_encode({"sub": "user1", "exp": time.time() + 60}, "s3cr3t")
+    claims = mw.jwt_decode(token, "s3cr3t")
+    assert claims["sub"] == "user1"
+    assert mw.jwt_decode(token, "wrong") is None
+    expired = mw.jwt_encode({"sub": "u", "exp": time.time() - 1}, "s3cr3t")
+    assert mw.jwt_decode(expired, "s3cr3t") is None
+
+    handler = mw.oauth_middleware("s3cr3t")(ok)
+    assert handler(make_request()).status == 401
+    req = make_request(headers={"Authorization": f"Bearer {token}"})
+    assert handler(req).status == 200
+    assert req.auth_subject == "user1"

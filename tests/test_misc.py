@@ -1,0 +1,187 @@
+"""Migrations, service client + circuit breaker, pub/sub broker, CLI, tracing."""
+
+import threading
+import time
+
+import pytest
+
+from gofr_tpu import new_mock_container
+from gofr_tpu.migration import MigrationError, run as run_migrations
+from gofr_tpu.pubsub.inproc import InProcBroker
+from gofr_tpu.service import (CircuitBreaker, CircuitBreakerConfig, CircuitOpenError,
+                              DefaultHeaders, HTTPService, new_http_service)
+from gofr_tpu.tracing import InMemoryExporter, Tracer, parse_traceparent
+
+
+# -- migrations ---------------------------------------------------------------
+def test_migrations_run_in_order_and_watermark():
+    c = new_mock_container()
+    order = []
+
+    def m1(ds):
+        ds.sql.exec("CREATE TABLE users (id INTEGER)")
+        order.append(1)
+
+    def m2(ds):
+        ds.kv.set("migrated", "yes")
+        order.append(2)
+
+    run_migrations({2: m2, 1: m1}, c)
+    assert order == [1, 2]
+    assert c.kv.get("migrated") == "yes"
+    # watermark persisted; re-run is a no-op
+    run_migrations({1: m1, 2: m2}, c)
+    assert order == [1, 2]
+    versions = {int(r["version"]) for r in c.sql.select(dict, "SELECT * FROM gofr_migrations")}
+    assert versions == {1, 2}
+
+
+def test_migration_failure_rolls_back():
+    c = new_mock_container()
+
+    def bad(ds):
+        ds.sql.exec("CREATE TABLE halfway (id INTEGER)")
+        raise RuntimeError("boom")
+
+    with pytest.raises(MigrationError):
+        run_migrations({1: bad}, c)
+    # table create rolled back with the tx
+    rows = c.sql.query("SELECT name FROM sqlite_master WHERE name='halfway'")
+    assert rows == []
+    # next run retries version 1
+    ran = []
+    run_migrations({1: lambda ds: ran.append(1)}, c)
+    assert ran == [1]
+
+
+def test_invalid_migration_version():
+    c = new_mock_container()
+    with pytest.raises(MigrationError):
+        run_migrations({0: lambda ds: None}, c)
+
+
+# -- circuit breaker ----------------------------------------------------------
+def test_circuit_breaker_opens_after_threshold():
+    svc = HTTPService("http://127.0.0.1:1")  # nothing listens here
+    svc.timeout_s = 0.05
+    breaker = CircuitBreakerConfig(threshold=2, interval_s=100).apply(svc)
+    for _ in range(3):
+        with pytest.raises(Exception):
+            breaker.get(None, "x")
+    assert breaker.open
+    with pytest.raises(CircuitOpenError):
+        breaker.get(None, "x")
+    assert breaker.health_check().status == "DOWN"
+
+
+def test_circuit_breaker_success_resets_count():
+    svc = HTTPService("http://example.invalid")
+    breaker = CircuitBreaker(svc, threshold=3, interval_s=100)
+    breaker.failure_count = 2
+    breaker._execute(lambda: "ok")
+    assert breaker.failure_count == 0
+
+
+def test_service_options_compose():
+    svc = new_http_service("http://x", None, None, DefaultHeaders(a="1", b="2"))
+    assert svc.default_headers == {"a": "1", "b": "2"}
+
+
+# -- in-proc broker -----------------------------------------------------------
+def test_broker_publish_subscribe_commit():
+    broker = InProcBroker()
+    broker.publish("t", b"m1")
+    broker.publish("t", b"m2")
+    msg = broker.subscribe("t", group="g", timeout_s=1)
+    assert msg.value == b"m1"
+    msg.commit()
+    msg = broker.subscribe("t", group="g", timeout_s=1)
+    assert msg.value == b"m2"
+    # uncommitted -> requeue redelivers
+    broker.requeue("t", group="g")
+    assert broker.subscribe("t", group="g", timeout_s=1).value == b"m2"
+
+
+def test_broker_independent_groups():
+    broker = InProcBroker()
+    broker.publish("t", b"x")
+    m1 = broker.subscribe("t", group="g1", timeout_s=1)
+    m2 = broker.subscribe("t", group="g2", timeout_s=1)
+    assert m1.value == m2.value == b"x"
+
+
+def test_broker_blocks_until_publish():
+    broker = InProcBroker()
+    result = {}
+
+    def consume():
+        result["msg"] = broker.subscribe("late", timeout_s=5)
+
+    t = threading.Thread(target=consume)
+    t.start()
+    time.sleep(0.05)
+    broker.publish("late", b"hello")
+    t.join(timeout=5)
+    assert result["msg"].value == b"hello"
+
+
+def test_broker_timeout_returns_none():
+    broker = InProcBroker()
+    assert broker.subscribe("empty", timeout_s=0.05) is None
+
+
+def test_message_bind():
+    from gofr_tpu.pubsub import Message
+
+    msg = Message("t", b'{"a": 5}')
+    assert msg.bind() == {"a": 5}
+
+
+# -- CLI ----------------------------------------------------------------------
+def test_cmd_app_routes_and_flags(capsys):
+    from gofr_tpu.cmd import CMDApp
+
+    c = new_mock_container()
+    app = CMDApp(container=c)
+
+    @app.sub_command("hello")
+    def hello(ctx):
+        return f"hello {ctx.param('name')}"
+
+    assert app.run(["hello", "-name=ada"]) == 0
+    assert "hello ada" in capsys.readouterr().out
+    assert app.run(["unknown"]) == 1
+    assert "No Command Found" in capsys.readouterr().err
+
+
+def test_cmd_bind_dataclass():
+    import dataclasses
+
+    from gofr_tpu.cmd import CMDRequest
+
+    @dataclasses.dataclass
+    class Args:
+        count: int = 0
+        verbose: bool = False
+
+    req = CMDRequest(["-count=3", "--verbose"])
+    args = req.bind(Args)
+    assert args.count == 3 and args.verbose is True
+
+
+# -- tracing ------------------------------------------------------------------
+def test_span_hierarchy_and_export():
+    exporter = InMemoryExporter()
+    tracer = Tracer(exporter=exporter)
+    with tracer.start_span("parent") as parent:
+        with tracer.start_span("child", parent=parent) as child:
+            child.set_attribute("k", "v")
+    assert len(exporter.spans) == 2
+    child_span, parent_span = exporter.spans
+    assert child_span.trace_id == parent_span.trace_id
+    assert child_span.parent_id == parent_span.span_id
+
+
+def test_parse_traceparent():
+    assert parse_traceparent("00-" + "a" * 32 + "-" + "b" * 16 + "-01") == ("a" * 32, "b" * 16)
+    assert parse_traceparent("garbage") is None
