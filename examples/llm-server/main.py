@@ -1,0 +1,124 @@
+"""LLM serving with continuous batching + SSE streaming: north-star config 4.
+
+POST /generate {"prompt": "...", "max_tokens": 64, "temperature": 0.7,
+"stream": true} -> server-sent events, one JSON per token chunk, then a final
+{"done": true} summary. stream=false returns one JSON response.
+
+Model size comes from MODEL_PRESET (debug | llama1b | llama3-8b); weights are
+random-initialised (no checkpoints ship in this environment) — the serving
+path, throughput, and latency behavior are identical to real weights.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from gofr_tpu import App, Stream  # noqa: E402
+from gofr_tpu.http.errors import InvalidParam  # noqa: E402
+from gofr_tpu.models.llama import LlamaConfig, llama_init  # noqa: E402
+from gofr_tpu.models.tokenizer import ByteTokenizer, StreamingDecoder  # noqa: E402
+from gofr_tpu.tpu.device import TPUClient  # noqa: E402
+from gofr_tpu.tpu.engine import LLMEngine  # noqa: E402
+from gofr_tpu.tpu.executor import Executor  # noqa: E402
+
+PRESETS = {
+    "debug": LlamaConfig.debug,
+    "llama1b": LlamaConfig.llama1b,
+    "llama3-8b": LlamaConfig.llama3_8b,
+}
+
+
+def build_engine(app: App) -> LLMEngine:
+    tpu = TPUClient(app.config)
+    app.add_tpu(tpu)
+    preset = app.config.get_or_default("MODEL_PRESET", "debug")
+    cfg = PRESETS[preset]()
+    # byte tokenizer unless a vocab file is deployed
+    tokenizer = ByteTokenizer()
+    if cfg.vocab_size < tokenizer.vocab_size:
+        raise ValueError("model vocab too small for byte tokenizer")
+    app.logger.infof("initialising %s (%.2fB params)...", preset,
+                     cfg.param_count() / 1e9)
+    params = llama_init(cfg, seed=0)
+    engine = LLMEngine(
+        params, cfg,
+        n_slots=app.config.get_int("MAX_BATCH", 8),
+        max_seq_len=app.config.get_int("MAX_SEQ_LEN", 1024),
+        prefill_buckets=tuple(int(b) for b in app.config.get_or_default(
+            "PREFILL_BUCKETS", "16,32,64,128,256").split(",")),
+        executor=Executor(tpu),
+        metrics=app.container.metrics_manager,
+        logger=app.logger,
+    )
+    engine.tokenizer = tokenizer
+    engine.start()
+    if app.config.get_bool("WARMUP", True):
+        t0 = time.time()
+        engine.warmup()
+        app.logger.infof("engine warmed up in %.1fs", time.time() - t0)
+    return engine
+
+
+def main() -> None:
+    os.chdir(os.path.dirname(os.path.abspath(__file__)))
+    app = App()
+    engine = build_engine(app)
+    tokenizer: ByteTokenizer = engine.tokenizer
+
+    @app.post("/generate")
+    def generate(ctx):
+        body = ctx.bind()
+        prompt = body.get("prompt")
+        if not isinstance(prompt, str) or not prompt:
+            raise InvalidParam(["prompt"])
+        max_tokens = int(body.get("max_tokens", 64))
+        temperature = float(body.get("temperature", 0.0))
+        stream = bool(body.get("stream", True))
+
+        request = engine.submit(
+            tokenizer.encode(prompt), max_new_tokens=max_tokens,
+            temperature=temperature, stop_tokens={tokenizer.EOS})
+
+        if not stream:
+            from gofr_tpu.http.errors import RequestTimeout
+
+            start = time.time()
+            try:
+                tokens = request.result(timeout_s=ctx.remaining())
+            except TimeoutError as exc:  # slot already freed by result()
+                raise RequestTimeout() from exc
+            return {"text": tokenizer.decode(tokens), "tokens": len(tokens),
+                    "seconds": round(time.time() - start, 3)}
+
+        def chunks():
+            decoder = StreamingDecoder(tokenizer)
+            count = 0
+            start = time.time()
+            for token in request.stream():
+                count += 1
+                text = decoder.push(token)
+                if text:
+                    yield {"text": text}
+            tail = decoder.flush()
+            if tail:
+                yield {"text": tail}
+            yield {"done": True, "tokens": count,
+                   "tok_per_s": round(count / max(time.time() - start, 1e-6), 1)}
+
+        return Stream(chunks(), sse=True, on_close=request.cancel)
+
+    @app.get("/stats")
+    def stats(ctx):
+        return {
+            "active_slots": sum(1 for s in engine.slots if s.active),
+            "queue_depth": engine._pending.qsize(),
+            "compiled_programs": engine.executor.cache_size,
+        }
+
+    app.run()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,14 @@
+"""Model zoo: pure-functional JAX models (params pytrees + apply fns).
+
+TPU-first choices: stacked per-layer weights consumed by lax.scan (one trace
+for all layers, fast compiles, pipeline-shardable), bfloat16 params with
+float32 softmax/norm accumulation, static shapes everywhere.
+"""
+
+from .llama import LlamaConfig, llama_decode_step, llama_forward, llama_init, llama_prefill
+from .mlp import MLPConfig, mlp_forward, mlp_init
+
+__all__ = [
+    "LlamaConfig", "llama_decode_step", "llama_forward", "llama_init",
+    "llama_prefill", "MLPConfig", "mlp_forward", "mlp_init",
+]

@@ -1,0 +1,272 @@
+"""Llama-family decoder: GQA + RoPE + RMSNorm + SwiGLU, cache-aware forward.
+
+Built TPU-first rather than ported: weights are stacked [n_layers, ...] and
+consumed by lax.scan (single-layer trace -> fast XLA compiles, natural
+pipeline sharding axis); matmuls stay bfloat16 for the MXU with float32
+softmax/norm accumulation; the KV cache is an explicit argument so serving
+code can donate it for in-place HBM updates (no torch-style module state).
+
+The unified `llama_forward` serves both phases of LLM serving:
+  - prefill: T>1 tokens written at positions [0..T), causal within the window
+  - decode:  T=1 token written at its absolute position, attending the cache
+Masking needs only `j <= q_pos` because cache slots are written contiguously
+from 0 — slot index IS absolute position.
+
+Config presets cover the BASELINE.md north-star ladder (debug CI model,
+1B bench model, Llama-3-8B, Llama-3-70B).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 128256
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    ffn_dim: int = 14336
+    max_seq_len: int = 8192
+    rope_theta: float = 500000.0
+    rms_eps: float = 1e-5
+    dtype: str = "bfloat16"
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    @property
+    def q_per_kv(self) -> int:
+        return self.n_heads // self.n_kv_heads
+
+    @classmethod
+    def debug(cls) -> "LlamaConfig":
+        """CI-sized model: compiles in seconds on CPU."""
+        return cls(vocab_size=512, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+                   ffn_dim=128, max_seq_len=256, dtype="float32")
+
+    @classmethod
+    def llama1b(cls) -> "LlamaConfig":
+        """Llama-3.2-1B shape: the single-v5e-chip bench model."""
+        return cls(vocab_size=128256, dim=2048, n_layers=16, n_heads=32,
+                   n_kv_heads=8, ffn_dim=8192, max_seq_len=8192)
+
+    @classmethod
+    def llama3_8b(cls) -> "LlamaConfig":
+        return cls(vocab_size=128256, dim=4096, n_layers=32, n_heads=32,
+                   n_kv_heads=8, ffn_dim=14336, max_seq_len=8192)
+
+    @classmethod
+    def llama3_70b(cls) -> "LlamaConfig":
+        return cls(vocab_size=128256, dim=8192, n_layers=80, n_heads=64,
+                   n_kv_heads=8, ffn_dim=28672, max_seq_len=8192)
+
+    def param_count(self) -> int:
+        embed = self.vocab_size * self.dim
+        per_layer = (self.dim * self.n_heads * self.head_dim          # wq
+                     + 2 * self.dim * self.n_kv_heads * self.head_dim  # wk, wv
+                     + self.n_heads * self.head_dim * self.dim         # wo
+                     + 3 * self.dim * self.ffn_dim                     # gate/up/down
+                     + 2 * self.dim)                                   # norms
+        return 2 * embed + self.n_layers * per_layer + self.dim
+
+
+def _np_dtype(name: str):
+    import jax.numpy as jnp
+
+    return {"bfloat16": jnp.bfloat16, "float32": jnp.float32,
+            "float16": jnp.float16}[name]
+
+
+def llama_init(cfg: LlamaConfig, seed: int = 0) -> Dict[str, Any]:
+    """Random-init params pytree with stacked [L, ...] layer weights."""
+    import jax
+    import jax.numpy as jnp
+
+    dtype = _np_dtype(cfg.dtype)
+    key = jax.random.PRNGKey(seed)
+    keys = jax.random.split(key, 8)
+    L, D, H, Hkv, dh, F, V = (cfg.n_layers, cfg.dim, cfg.n_heads, cfg.n_kv_heads,
+                              cfg.head_dim, cfg.ffn_dim, cfg.vocab_size)
+
+    def init(k, shape, fan_in):
+        return (jax.random.normal(k, shape, dtype=jnp.float32)
+                * (1.0 / math.sqrt(fan_in))).astype(dtype)
+
+    return {
+        "tok_emb": init(keys[0], (V, D), D),
+        "layers": {
+            "wq": init(keys[1], (L, D, H * dh), D),
+            "wk": init(keys[2], (L, D, Hkv * dh), D),
+            "wv": init(keys[3], (L, D, Hkv * dh), D),
+            "wo": init(keys[4], (L, H * dh, D), H * dh),
+            "w_gate": init(keys[5], (L, D, F), D),
+            "w_up": init(keys[6], (L, D, F), D),
+            "w_down": init(keys[7], (L, F, D), F),
+            "attn_norm": jnp.ones((L, D), dtype=dtype),
+            "ffn_norm": jnp.ones((L, D), dtype=dtype),
+        },
+        "final_norm": jnp.ones((D,), dtype=dtype),
+        "lm_head": init(keys[0], (D, V), D),
+    }
+
+
+def init_kv_cache(cfg: LlamaConfig, batch: int, seq_len: Optional[int] = None,
+                  dtype: Optional[str] = None) -> Tuple[Any, Any]:
+    """Zeroed (k, v) caches shaped [L, B, S, Hkv, dh]."""
+    import jax.numpy as jnp
+
+    S = seq_len or cfg.max_seq_len
+    shape = (cfg.n_layers, batch, S, cfg.n_kv_heads, cfg.head_dim)
+    dt = _np_dtype(dtype or cfg.dtype)
+    return jnp.zeros(shape, dtype=dt), jnp.zeros(shape, dtype=dt)
+
+
+def rms_norm(x, weight, eps: float):
+    import jax.numpy as jnp
+
+    x32 = x.astype(jnp.float32)
+    normed = x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+    return (normed * weight.astype(jnp.float32)).astype(x.dtype)
+
+
+def rope(x, positions, theta: float):
+    """Rotate-half RoPE. x: [B, T, H, dh]; positions: [B, T] int32."""
+    import jax.numpy as jnp
+
+    dh = x.shape[-1]
+    half = dh // 2
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
+    angles = positions.astype(jnp.float32)[..., None] * inv_freq  # [B, T, half]
+    cos = jnp.cos(angles)[:, :, None, :]  # [B, T, 1, half]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = x[..., :half].astype(jnp.float32), x[..., half:].astype(jnp.float32)
+    rotated = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return rotated.astype(x.dtype)
+
+
+import jax  # noqa: E402  (after dataclass defs so module import stays light)
+import jax.numpy as jnp  # noqa: E402
+
+
+def _attention_block(x, layer, k_cache_l, v_cache_l, positions, cfg: LlamaConfig):
+    """One attention sublayer with cache write + masked read.
+
+    x: [B, T, D]; k/v_cache_l: [B, S, Hkv, dh]; positions: [B, T].
+    Returns (out [B, T, D], k_cache_l, v_cache_l).
+    """
+    B, T, D = x.shape
+    S = k_cache_l.shape[1]
+    H, Hkv, dh, G = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim, cfg.q_per_kv
+
+    normed = rms_norm(x, layer["attn_norm"], cfg.rms_eps)
+    q = (normed @ layer["wq"]).reshape(B, T, H, dh)
+    k = (normed @ layer["wk"]).reshape(B, T, Hkv, dh)
+    v = (normed @ layer["wv"]).reshape(B, T, Hkv, dh)
+    q = rope(q, positions, cfg.rope_theta)
+    k = rope(k, positions, cfg.rope_theta)
+
+    # scatter this chunk's k/v into the cache at its absolute positions
+    batch_idx = jnp.arange(B)[:, None]
+    k_cache_l = k_cache_l.at[batch_idx, positions].set(k)
+    v_cache_l = v_cache_l.at[batch_idx, positions].set(v)
+
+    # GQA attention over the cache: q grouped [B, T, Hkv, G, dh]
+    qg = q.reshape(B, T, Hkv, G, dh)
+    scores = jnp.einsum("bthgd,bshd->bhgts", qg.astype(jnp.float32),
+                        k_cache_l.astype(jnp.float32)) / math.sqrt(dh)
+    # mask: query at absolute pos p sees cache slot j iff j <= p
+    cache_pos = jnp.arange(S)[None, None, :]                  # [1, 1, S]
+    visible = cache_pos <= positions[:, :, None]              # [B, T, S]
+    scores = jnp.where(visible[:, None, None, :, :], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhgts,bshd->bthgd", probs,
+                     v_cache_l.astype(jnp.float32)).astype(x.dtype)
+    out = out.reshape(B, T, H * dh) @ layer["wo"]
+    return out, k_cache_l, v_cache_l
+
+
+def _ffn_block(x, layer, cfg: LlamaConfig):
+    normed = rms_norm(x, layer["ffn_norm"], cfg.rms_eps)
+    gate = jax.nn.silu(normed @ layer["w_gate"])
+    up = normed @ layer["w_up"]
+    return (gate * up) @ layer["w_down"]
+
+
+def llama_forward(params, cfg: LlamaConfig, tokens, positions, k_cache, v_cache):
+    """Cache-writing forward over a token chunk.
+
+    tokens: [B, T] int32; positions: [B, T] absolute positions (row-wise
+    monotonic); k/v_cache: [L, B, S, Hkv, dh].
+    Returns (logits [B, T, V] float32, k_cache, v_cache).
+    """
+    x = params["tok_emb"][tokens]
+
+    def body(x, scan_in):
+        layer, k_l, v_l = scan_in
+        attn_out, k_l, v_l = _attention_block(x, layer, k_l, v_l, positions, cfg)
+        x = x + attn_out
+        x = x + _ffn_block(x, layer, cfg)
+        return x, (k_l, v_l)
+
+    x, (k_cache, v_cache) = jax.lax.scan(
+        body, x, (params["layers"], k_cache, v_cache))
+    x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    return logits, k_cache, v_cache
+
+
+def llama_prefill(params, cfg: LlamaConfig, tokens, k_cache, v_cache):
+    """Prefill from empty cache: positions are [0..T) for every row."""
+    B, T = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None, :], (B, T))
+    return llama_forward(params, cfg, tokens, positions, k_cache, v_cache)
+
+
+def llama_decode_step(params, cfg: LlamaConfig, tokens, positions, k_cache, v_cache):
+    """One decode step for every batch row.
+
+    tokens: [B] current token per row; positions: [B] its absolute position.
+    Returns (logits [B, V], k_cache, v_cache).
+    """
+    logits, k_cache, v_cache = llama_forward(
+        params, cfg, tokens[:, None], positions[:, None], k_cache, v_cache)
+    return logits[:, 0, :], k_cache, v_cache
+
+
+def llama_forward_nocache(params, cfg: LlamaConfig, tokens):
+    """Training/eval forward without a cache: plain causal attention.
+
+    Kept separate from the serving path so the training step doesn't carry
+    cache plumbing; shares every sublayer weight and math with llama_forward.
+    """
+    B, T = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None, :], (B, T))
+    x = params["tok_emb"][tokens]
+    H, Hkv, dh, G = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim, cfg.q_per_kv
+    causal = jnp.tril(jnp.ones((T, T), dtype=bool))
+
+    def body(x, layer):
+        normed = rms_norm(x, layer["attn_norm"], cfg.rms_eps)
+        q = rope((normed @ layer["wq"]).reshape(B, T, H, dh), positions, cfg.rope_theta)
+        k = rope((normed @ layer["wk"]).reshape(B, T, Hkv, dh), positions, cfg.rope_theta)
+        v = (normed @ layer["wv"]).reshape(B, T, Hkv, dh)
+        qg = q.reshape(B, T, Hkv, G, dh)
+        scores = jnp.einsum("bthgd,bshd->bhgts", qg.astype(jnp.float32),
+                            k.astype(jnp.float32)) / math.sqrt(dh)
+        scores = jnp.where(causal[None, None, None, :, :], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        attn = jnp.einsum("bhgts,bshd->bthgd", probs,
+                          v.astype(jnp.float32)).astype(x.dtype)
+        x = x + attn.reshape(B, T, H * dh) @ layer["wo"]
+        x = x + _ffn_block(x, layer, cfg)
+        return x, None
+
+    x, _ = jax.lax.scan(body, x, params["layers"])
+    x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+    return (x @ params["lm_head"]).astype(jnp.float32)

@@ -1,0 +1,45 @@
+"""MNIST-class MLP: the minimum end-to-end model (BASELINE.md config 2)."""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class MLPConfig:
+    in_dim: int = 784
+    hidden_dims: Tuple[int, ...] = (512, 256)
+    out_dim: int = 10
+    dtype: str = "float32"
+
+
+def mlp_init(cfg: MLPConfig, seed: int = 0) -> Dict[str, Any]:
+    import jax
+    import jax.numpy as jnp
+
+    dtype = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}[cfg.dtype]
+    dims = (cfg.in_dim,) + cfg.hidden_dims + (cfg.out_dim,)
+    key = jax.random.PRNGKey(seed)
+    params = []
+    for i in range(len(dims) - 1):
+        key, sub = jax.random.split(key)
+        params.append({
+            "w": (jax.random.normal(sub, (dims[i], dims[i + 1]), dtype=jnp.float32)
+                  * (1.0 / math.sqrt(dims[i]))).astype(dtype),
+            "b": jnp.zeros((dims[i + 1],), dtype=dtype),
+        })
+    return {"layers": params}
+
+
+def mlp_forward(params, x):
+    """x: [B, in_dim] -> logits [B, out_dim]."""
+    import jax
+    import jax.numpy as jnp
+
+    layers = params["layers"]
+    for layer in layers[:-1]:
+        x = jax.nn.relu(x @ layer["w"] + layer["b"])
+    last = layers[-1]
+    return (x @ last["w"] + last["b"]).astype(jnp.float32)

@@ -1,0 +1,121 @@
+"""Tokenizers for serving examples: byte-level (zero-dependency) + BPE loader.
+
+A serving framework needs a tokenizer in the request path (SURVEY.md §7.5
+"tokenizer in Go" -> here in the serving process, no Python-ecosystem
+dependency at runtime). ByteTokenizer is exact and reversible for any UTF-8
+text; BPETokenizer loads a vocab/merges file when a real model vocabulary is
+available (none ships in this zero-egress environment).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional, Sequence
+
+
+class ByteTokenizer:
+    """256 byte tokens + specials. vocab: [bytes 0..255, <pad>, <bos>, <eos>]."""
+
+    PAD = 256
+    BOS = 257
+    EOS = 258
+
+    vocab_size = 259
+
+    def encode(self, text: str, bos: bool = True, eos: bool = False) -> List[int]:
+        ids = list(text.encode("utf-8"))
+        if bos:
+            ids = [self.BOS] + ids
+        if eos:
+            ids = ids + [self.EOS]
+        return ids
+
+    def decode(self, ids: Sequence[int]) -> str:
+        data = bytes(i for i in ids if 0 <= i < 256)
+        return data.decode("utf-8", errors="replace")
+
+    def decode_token(self, token: int) -> str:
+        """Single-token streaming decode; multibyte UTF-8 may yield ''."""
+        if 0 <= token < 256:
+            return bytes([token]).decode("utf-8", errors="ignore")
+        return ""
+
+
+class StreamingDecoder:
+    """Accumulates byte tokens and yields complete UTF-8 characters — what the
+    SSE token stream sends so clients never see broken codepoints."""
+
+    def __init__(self, tokenizer: Optional[ByteTokenizer] = None):
+        self.tokenizer = tokenizer or ByteTokenizer()
+        self._buf = bytearray()
+
+    def push(self, token: int) -> str:
+        if not (0 <= token < 256):
+            return ""
+        self._buf.append(token)
+        try:
+            text = self._buf.decode("utf-8")
+            self._buf.clear()
+            return text
+        except UnicodeDecodeError:
+            if len(self._buf) >= 4:  # not a valid prefix; flush replacement
+                text = self._buf.decode("utf-8", errors="replace")
+                self._buf.clear()
+                return text
+            return ""
+
+    def flush(self) -> str:
+        text = self._buf.decode("utf-8", errors="replace")
+        self._buf.clear()
+        return text
+
+
+class BPETokenizer:
+    """Greedy byte-pair tokenizer over a {token_string: id} vocab + ranked merges.
+
+    File format: JSON {"vocab": {...}, "merges": ["a b", ...]} — the common
+    interchange shape. Used when a real model vocabulary is provided at deploy
+    time; examples default to ByteTokenizer.
+    """
+
+    def __init__(self, vocab: Dict[str, int], merges: List[str],
+                 bos_token: str = "<s>", eos_token: str = "</s>"):
+        self.vocab = vocab
+        self.inv_vocab = {v: k for k, v in vocab.items()}
+        self.ranks = {tuple(m.split(" ")): i for i, m in enumerate(merges)}
+        self.bos_id = vocab.get(bos_token)
+        self.eos_id = vocab.get(eos_token)
+        self.vocab_size = max(vocab.values()) + 1 if vocab else 0
+
+    @classmethod
+    def from_file(cls, path: str, **kw) -> "BPETokenizer":
+        with open(path, "r", encoding="utf-8") as fp:
+            data = json.load(fp)
+        return cls(data["vocab"], data.get("merges", []), **kw)
+
+    def _bpe(self, word: List[str]) -> List[str]:
+        while len(word) > 1:
+            pairs = [(self.ranks.get((word[i], word[i + 1]), float("inf")), i)
+                     for i in range(len(word) - 1)]
+            best_rank, best_i = min(pairs)
+            if best_rank == float("inf"):
+                break
+            word = word[:best_i] + [word[best_i] + word[best_i + 1]] + word[best_i + 2:]
+        return word
+
+    def encode(self, text: str, bos: bool = True, eos: bool = False) -> List[int]:
+        ids: List[int] = []
+        if bos and self.bos_id is not None:
+            ids.append(self.bos_id)
+        for piece in self._bpe(list(text)):
+            if piece in self.vocab:
+                ids.append(self.vocab[piece])
+            else:
+                ids.extend(self.vocab.get(ch, 0) for ch in piece)
+        if eos and self.eos_id is not None:
+            ids.append(self.eos_id)
+        return ids
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return "".join(self.inv_vocab.get(i, "") for i in ids
+                       if i not in (self.bos_id, self.eos_id))

@@ -1,0 +1,11 @@
+"""TPU serving runtime: device client, AOT executor, batching schedulers.
+
+This is the layer-3 datasource + layer-7 runtime the SURVEY.md TPU mapping
+calls for: the device client is a Container datasource (like SQL/KV), and the
+schedulers bridge HTTP/gRPC/pub-sub ingress to padded XLA executions.
+"""
+
+from .device import TPUClient
+from .executor import Executor, next_bucket, pad_to
+
+__all__ = ["TPUClient", "Executor", "next_bucket", "pad_to"]

@@ -1,0 +1,195 @@
+"""TPU device client: the Container datasource wrapping the JAX/XLA runtime.
+
+Parity: the reference's injected-datasource provider pattern
+(pkg/gofr/datasource/mongo.go:41-74 — New(Config) + UseLogger/UseMetrics/
+Connect, wired by externalDB.go:5-12) and its HealthCheck feeding
+/.well-known/health (container/health.go:39-59). Where the reference's
+datasource boundary is a TCP connection to a database, this one is the
+process<->accelerator boundary: device enumeration, HBM usage, mesh
+construction, and the TPU metric set (SURVEY.md §5: tokens/sec, TTFT/TPOT,
+batch size, HBM bytes, queue depth, compile-cache hits).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+from ..datasource import Health, STATUS_DEGRADED, STATUS_DOWN, STATUS_UP
+
+TTFT_BUCKETS = (0.01, 0.025, 0.05, 0.1, 0.15, 0.25, 0.5, 1, 2.5, 5, 10)
+TPOT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 1)
+BATCH_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256)
+
+
+class TPUClient:
+    """Holds the JAX device handles; everything model-facing goes through it."""
+
+    def __init__(self, config=None, platform: Optional[str] = None):
+        self.config = config
+        self.platform_override = platform or (
+            config.get_or_default("TPU_PLATFORM", "") if config is not None else "")
+        self.logger = None
+        self.metrics = None
+        self._devices: List[Any] = []
+        self._connected_at: Optional[float] = None
+        self._jax = None
+
+    # -- provider pattern (mongo.go:142-155) ----------------------------------
+    def use_logger(self, logger) -> None:
+        self.logger = logger
+
+    def use_metrics(self, metrics) -> None:
+        self.metrics = metrics
+
+    def connect(self) -> None:
+        import jax
+
+        self._jax = jax
+        if self.platform_override:
+            # pin the whole process to the requested platform BEFORE backends
+            # initialize — environments that pre-register an accelerator
+            # plugin (e.g. the axon TPU tunnel) force-set jax_platforms at
+            # interpreter start, so TPU_PLATFORM=cpu must win here to keep
+            # CI/dev runs off the single-tenant device
+            try:
+                jax.config.update("jax_platforms", self.platform_override)
+            except Exception:  # noqa: BLE001
+                pass
+            self._devices = jax.devices(self.platform_override)
+        else:
+            self._devices = jax.devices()
+        self._connected_at = time.time()
+        if self.metrics is not None:
+            self.register_metrics()
+        if self.logger is not None:
+            kinds = {d.device_kind for d in self._devices}
+            self.logger.infof("connected to %d %s device(s): %s",
+                              len(self._devices), self.platform,
+                              ", ".join(sorted(kinds)))
+
+    @classmethod
+    def from_config(cls, config, logger, metrics) -> "TPUClient":
+        client = cls(config)
+        client.use_logger(logger)
+        client.use_metrics(metrics)
+        client.connect()
+        return client
+
+    def register_metrics(self) -> None:
+        m = self.metrics
+        for name, desc in (
+            ("app_tpu_compile_total", "XLA compilations performed"),
+            ("app_tpu_compile_cache_hits", "executor compile-cache hits"),
+            ("app_tpu_execute_total", "device executions dispatched"),
+            ("app_tpu_tokens_generated_total", "output tokens generated"),
+            ("app_tpu_requests_total", "inference requests admitted"),
+        ):
+            try:
+                m.new_counter(name, desc)
+            except Exception:  # noqa: BLE001 - re-registration on reconnect
+                pass
+        for name, desc in (
+            ("app_tpu_queue_depth", "requests waiting for batch assembly"),
+            ("app_tpu_active_slots", "occupied continuous-batching slots"),
+            ("app_tpu_hbm_bytes_used", "HBM bytes in use per device"),
+            ("app_tpu_hbm_bytes_limit", "HBM bytes available per device"),
+            ("app_tpu_tokens_per_second", "rolling decode throughput"),
+        ):
+            try:
+                m.new_gauge(name, desc)
+            except Exception:  # noqa: BLE001
+                pass
+        for name, desc, buckets in (
+            ("app_tpu_ttft_seconds", "time to first token", TTFT_BUCKETS),
+            ("app_tpu_tpot_seconds", "time per output token", TPOT_BUCKETS),
+            ("app_tpu_batch_size", "assembled batch sizes", BATCH_BUCKETS),
+            ("app_tpu_execute_seconds", "device execution wall time", TPOT_BUCKETS),
+        ):
+            try:
+                m.new_histogram(name, desc, buckets)
+            except Exception:  # noqa: BLE001
+                pass
+
+    # -- device surface -------------------------------------------------------
+    @property
+    def devices(self) -> List[Any]:
+        return self._devices
+
+    @property
+    def device_count(self) -> int:
+        return len(self._devices)
+
+    @property
+    def platform(self) -> str:
+        return self._devices[0].platform if self._devices else "none"
+
+    def mesh(self, axes: Dict[str, int]):
+        """Build a jax.sharding.Mesh over the client's devices.
+
+        axes: ordered {axis_name: size}; product must equal device_count
+        (pass -1 for one axis to infer it).
+        """
+        import numpy as np
+        from jax.sharding import Mesh
+
+        names = list(axes.keys())
+        sizes = list(axes.values())
+        if -1 in sizes:
+            known = int(np.prod([s for s in sizes if s != -1]))
+            sizes[sizes.index(-1)] = len(self._devices) // known
+        total = int(np.prod(sizes))
+        if total != len(self._devices):
+            raise ValueError(f"mesh axes {dict(zip(names, sizes))} need {total} devices, "
+                             f"have {len(self._devices)}")
+        return Mesh(np.array(self._devices).reshape(sizes), tuple(names))
+
+    def memory_stats(self) -> List[Dict[str, Any]]:
+        out = []
+        for d in self._devices:
+            try:
+                stats = d.memory_stats() or {}
+            except Exception:  # noqa: BLE001 - CPU backends have no stats
+                stats = {}
+            out.append({
+                "id": d.id,
+                "kind": d.device_kind,
+                "bytes_in_use": stats.get("bytes_in_use", 0),
+                "bytes_limit": stats.get("bytes_limit", 0),
+            })
+        return out
+
+    def refresh_memory_metrics(self) -> None:
+        if self.metrics is None:
+            return
+        for s in self.memory_stats():
+            self.metrics.set_gauge("app_tpu_hbm_bytes_used", s["bytes_in_use"],
+                                   device=str(s["id"]))
+            self.metrics.set_gauge("app_tpu_hbm_bytes_limit", s["bytes_limit"],
+                                   device=str(s["id"]))
+
+    # -- health (feeds /.well-known/health) -----------------------------------
+    def health_check(self) -> Health:
+        if not self._devices:
+            return Health(status=STATUS_DOWN, details={"error": "no devices"})
+        try:
+            import jax.numpy as jnp
+
+            # tiny device round-trip proves the runtime is actually alive,
+            # like the SQL ping (sql/health.go:26-65)
+            probe = float(jnp.asarray(1.0) + 1.0)
+            ok = probe == 2.0
+        except Exception as exc:  # noqa: BLE001
+            return Health(status=STATUS_DOWN, details={"error": str(exc)})
+        self.refresh_memory_metrics()
+        mem = self.memory_stats()
+        status = STATUS_UP if ok else STATUS_DEGRADED
+        return Health(status=status, details={
+            "platform": self.platform,
+            "devices": len(self._devices),
+            "memory": mem,
+            "uptime_s": round(time.time() - (self._connected_at or time.time()), 1),
+        })
+
+    def close(self) -> None:
+        self._devices = []

@@ -1,0 +1,427 @@
+"""Continuous-batching LLM engine: slot-based decode with device-resident KV cache.
+
+The TPU-first shape of the problem (SURVEY.md §5 long-context + §7.5):
+  - a fixed pool of `n_slots` sequences decodes in lock-step — one compiled
+    decode program, static shapes, no per-request recompiles
+  - the KV cache lives in HBM as [L, n_slots, S, Hkv, dh] and is DONATED to
+    every prefill/decode call, so XLA updates it in place (no copy per token)
+  - prefills are bucketed by prompt length (powers of two) to bound the
+    number of compiled programs; the padded tail of a prefill writes junk k/v
+    that is provably overwritten before it is ever attended to (slot index ==
+    absolute position and the mask is j <= q_pos)
+  - requests stream tokens out through per-request queues; new requests are
+    admitted into free slots between decode steps (continuous batching), so
+    short and long generations share the batch without head-of-line blocking
+
+The reference's analog is the per-topic subscriber loop + per-request
+goroutine bridging (subscriber.go:27-57, handler.go:58-63); here the "broker"
+is the admission queue and the "handler" is the decode loop.
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+import time
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Set
+
+from ..models.llama import (LlamaConfig, init_kv_cache, llama_decode_step,
+                            llama_forward)
+from .executor import Executor, next_bucket
+from .obs import MetricsHook
+from .sampling import sample_tokens
+
+
+class CacheLostError(RuntimeError):
+    """A donated-cache program failed after dispatch: the KV cache buffers may
+    already be consumed (donation is honored on TPU/GPU), so the engine must
+    rebuild device state before serving again."""
+
+_request_ids = itertools.count(1)
+
+
+class GenerationRequest:
+    def __init__(self, prompt_tokens: Sequence[int], max_new_tokens: int = 128,
+                 temperature: float = 0.0, stop_tokens: Optional[Set[int]] = None):
+        self.id = next(_request_ids)
+        self.prompt_tokens = list(prompt_tokens)
+        self.max_new_tokens = max_new_tokens
+        self.temperature = float(temperature)
+        self.stop_tokens = stop_tokens or set()
+        self.out_queue: "queue.Queue" = queue.Queue()
+        self.cancelled = threading.Event()
+        self.error: Optional[BaseException] = None
+        self.enqueued_at = time.time()
+        self.first_token_at: Optional[float] = None
+        self.finished_at: Optional[float] = None
+        self.generated = 0
+
+    def cancel(self) -> None:
+        self.cancelled.set()
+
+    def stream(self, timeout_s: Optional[float] = None) -> Iterator[int]:
+        """Yield generated token ids until the engine signals completion.
+
+        timeout_s bounds the wait for EACH token; on expiry the request is
+        cancelled (freeing its slot) and TimeoutError raised."""
+        while True:
+            try:
+                token = self.out_queue.get(timeout=timeout_s)
+            except queue.Empty:
+                self.cancel()
+                raise TimeoutError(
+                    f"generation timed out after {timeout_s}s waiting for a token")
+            if token is None:
+                if self.error is not None:
+                    raise self.error
+                return
+            yield token
+
+    def result(self, timeout_s: Optional[float] = None) -> List[int]:
+        return list(self.stream(timeout_s=timeout_s))
+
+
+class _Slot:
+    __slots__ = ("request", "length", "remaining")
+
+    def __init__(self):
+        self.request: Optional[GenerationRequest] = None
+        self.length = 0
+        self.remaining = 0
+
+    @property
+    def active(self) -> bool:
+        return self.request is not None
+
+
+class LLMEngine:
+    def __init__(
+        self,
+        params,
+        cfg: LlamaConfig,
+        n_slots: int = 8,
+        max_seq_len: Optional[int] = None,
+        prefill_buckets: Sequence[int] = (16, 32, 64, 128, 256, 512, 1024),
+        top_k: int = 0,
+        executor: Optional[Executor] = None,
+        metrics=None,
+        logger=None,
+        seed: int = 0,
+    ):
+        import jax
+        import jax.numpy as jnp
+
+        self.params = params
+        self.cfg = cfg
+        self.n_slots = n_slots
+        self.max_seq_len = min(max_seq_len or cfg.max_seq_len, cfg.max_seq_len)
+        self.prefill_buckets = tuple(b for b in prefill_buckets if b <= self.max_seq_len)
+        self.top_k = top_k
+        self.executor = executor or Executor()
+        self.metrics = metrics if metrics is not None else self.executor.metrics
+        self.logger = logger
+
+        self.k_cache, self.v_cache = init_kv_cache(cfg, n_slots, self.max_seq_len)
+        self.rng = jax.random.PRNGKey(seed)
+        self.slots = [_Slot() for _ in range(n_slots)]
+        self._pending: "queue.Queue[GenerationRequest]" = queue.Queue()
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._jnp = jnp
+        self._obs = MetricsHook(self.metrics)
+
+        # rolling throughput window
+        self._tok_window: List[tuple] = []
+
+        # host-side mirrors of per-slot device state
+        self._cur_tokens = [0] * n_slots
+        self._temps = [0.0] * n_slots
+
+    # -- public API -----------------------------------------------------------
+    def submit(self, prompt_tokens: Sequence[int], max_new_tokens: int = 128,
+               temperature: float = 0.0,
+               stop_tokens: Optional[Set[int]] = None) -> GenerationRequest:
+        if self._stop.is_set():
+            raise RuntimeError("engine is stopped")
+        if not prompt_tokens:
+            raise ValueError("prompt_tokens must be non-empty")
+        # the first decode step writes the new token's KV at position
+        # len(prompt), which must stay inside the cache's seq dim
+        bucket_limit = self.prefill_buckets[-1] if self.prefill_buckets else self.max_seq_len
+        limit = min(bucket_limit, self.max_seq_len - 1)
+        if len(prompt_tokens) > limit:
+            raise ValueError(f"prompt of {len(prompt_tokens)} tokens exceeds the "
+                             f"admission limit ({limit})")
+        request = GenerationRequest(prompt_tokens, max_new_tokens, temperature, stop_tokens)
+        self._obs.counter("app_tpu_requests_total")
+        self._pending.put(request)
+        self._obs.gauge("app_tpu_queue_depth", self._pending.qsize())
+        self._wake.set()
+        return request
+
+    def generate(self, prompt_tokens: Sequence[int], **kw) -> List[int]:
+        return self.submit(prompt_tokens, **kw).result()
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop, name="llm-engine", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+        self._drain_pending(RuntimeError("engine stopped"))
+
+    def warmup(self) -> None:
+        """Pre-compile every prefill bucket + the decode step at boot."""
+        import numpy as np
+
+        for bucket in self.prefill_buckets:
+            tokens = np.zeros((1, bucket), dtype=np.int32)
+            self._prefill_program(bucket)  # compile only
+            if self.logger is not None:
+                self.logger.debugf("warmed prefill bucket %d", bucket)
+            del tokens
+        self._decode_program()
+
+    # -- compiled programs ----------------------------------------------------
+    def _prefill_fn(self, bucket: int):
+        cfg = self.cfg
+        jnp = self._jnp
+        import jax
+
+        def prefill(params, k_cache, v_cache, tokens, slot, length):
+            """tokens: [1, bucket]; writes slot row of the big cache.
+            Returns (k_cache, v_cache, last_logits [V])."""
+            L, _, S, Hkv, dh = k_cache.shape
+            tmp_k = jnp.zeros((L, 1, bucket, Hkv, dh), dtype=k_cache.dtype)
+            tmp_v = jnp.zeros_like(tmp_k)
+            positions = jnp.arange(bucket, dtype=jnp.int32)[None, :]
+            logits, tmp_k, tmp_v = llama_forward(params, cfg, tokens, positions,
+                                                 tmp_k, tmp_v)
+            k_cache = jax.lax.dynamic_update_slice(k_cache, tmp_k, (0, slot, 0, 0, 0))
+            v_cache = jax.lax.dynamic_update_slice(v_cache, tmp_v, (0, slot, 0, 0, 0))
+            last = logits[0, length - 1, :]
+            return k_cache, v_cache, last
+
+        return prefill
+
+    def _prefill_program(self, bucket: int):
+        import numpy as np
+
+        tokens = self._jnp.zeros((1, bucket), dtype=self._jnp.int32)
+        return self.executor.compile(
+            f"llama-prefill-{bucket}", self._prefill_fn(bucket),
+            (self.params, self.k_cache, self.v_cache, tokens,
+             np.int32(0), np.int32(1)),
+            donate_argnums=(1, 2))
+
+    def _decode_fn(self):
+        cfg = self.cfg
+        top_k = self.top_k
+
+        def decode(params, k_cache, v_cache, tokens, positions, temps, rng):
+            logits, k_cache, v_cache = llama_decode_step(
+                params, cfg, tokens, positions, k_cache, v_cache)
+            next_tokens, rng = sample_tokens(logits, rng, temps, top_k=top_k)
+            return k_cache, v_cache, next_tokens, rng
+
+        return decode
+
+    def _decode_program(self):
+        jnp = self._jnp
+        B = self.n_slots
+        args = (self.params, self.k_cache, self.v_cache,
+                jnp.zeros((B,), dtype=jnp.int32), jnp.zeros((B,), dtype=jnp.int32),
+                jnp.zeros((B,), dtype=jnp.float32), self.rng)
+        return self.executor.compile("llama-decode", self._decode_fn(), args,
+                                     donate_argnums=(1, 2))
+
+    # -- engine loop ----------------------------------------------------------
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            admitted = self._admit()
+            any_active = any(slot.active for slot in self.slots)
+            if not any_active:
+                self._wake.wait(timeout=0.1)
+                self._wake.clear()
+                continue
+            try:
+                self._decode_once()
+            except Exception as exc:  # noqa: BLE001 - fail active requests, keep serving
+                if self.logger is not None:
+                    self.logger.errorf("decode step failed: %s", exc)
+                self._reset_device_state(exc)
+            del admitted
+
+    def _admit(self) -> int:
+        """Move pending requests into free slots (runs a prefill per admit)."""
+        admitted = 0
+        for slot_idx, slot in enumerate(self.slots):
+            if slot.active:
+                continue
+            request = None
+            while request is None:
+                try:
+                    request = self._pending.get_nowait()
+                except queue.Empty:
+                    break
+                if request.cancelled.is_set():
+                    request.out_queue.put(None)
+                    request = None
+            if request is None:
+                break
+            try:
+                self._prefill_into(slot_idx, slot, request)
+                admitted += 1
+            except Exception as exc:  # noqa: BLE001 - bad request must not kill the loop
+                request.error = exc
+                request.out_queue.put(None)
+                slot.request = None
+                # the prefill program donates the caches; a failure after
+                # dispatch may have consumed them, so rebuild device state
+                # (fails any other active request — their KV is gone too)
+                self._reset_device_state(exc)
+        self._obs.gauge("app_tpu_queue_depth", self._pending.qsize())
+        self._obs.gauge("app_tpu_active_slots",
+                            sum(1 for s in self.slots if s.active))
+        return admitted
+
+    def _prefill_into(self, slot_idx: int, slot: _Slot, request: GenerationRequest) -> None:
+        import numpy as np
+
+        length = len(request.prompt_tokens)
+        bucket = next_bucket(length, self.prefill_buckets)
+        tokens = np.zeros((1, bucket), dtype=np.int32)
+        tokens[0, :length] = request.prompt_tokens
+        program = self._prefill_program(bucket)
+        self.k_cache, self.v_cache, last_logits = program(
+            self.params, self.k_cache, self.v_cache, self._jnp.asarray(tokens),
+            np.int32(slot_idx), np.int32(length))
+
+        # sample the first token from the prefill logits on host (single row)
+        first = self._sample_host(last_logits, request.temperature)
+        now = time.time()
+        request.first_token_at = now
+        self._obs.hist("app_tpu_ttft_seconds", now - request.enqueued_at)
+        self._emit(request, first)
+
+        slot.request = request
+        # length counts tokens whose KV is in the cache (the prompt); the
+        # just-sampled first token is written at position `length` by the
+        # next decode step
+        slot.length = length
+        slot.remaining = request.max_new_tokens - 1
+        self._cur_tokens[slot_idx] = first
+        self._temps[slot_idx] = request.temperature
+        if first in request.stop_tokens or slot.remaining <= 0:
+            self._finish_slot(slot)
+
+    def _sample_host(self, logits_row, temperature: float) -> int:
+        import numpy as np
+
+        # same sampling program as decode steps so top_k applies to the
+        # first token too
+        tokens, self.rng = sample_tokens(
+            logits_row[None, :], self.rng,
+            self._jnp.asarray([temperature], dtype=self._jnp.float32),
+            top_k=self.top_k)
+        return int(np.asarray(tokens[0]))
+
+    def _decode_once(self) -> None:
+        import numpy as np
+
+        jnp = self._jnp
+        B = self.n_slots
+        tokens = np.zeros((B,), dtype=np.int32)
+        positions = np.zeros((B,), dtype=np.int32)
+        temps = np.zeros((B,), dtype=np.float32)
+        for i, slot in enumerate(self.slots):
+            if slot.active:
+                tokens[i] = self._cur_tokens[i]
+                positions[i] = slot.length  # write the new token's kv here
+                temps[i] = self._temps[i]
+
+        program = self._decode_program()
+        start = time.time()
+        self.k_cache, self.v_cache, next_tokens, self.rng = program(
+            self.params, self.k_cache, self.v_cache, jnp.asarray(tokens),
+            jnp.asarray(positions), jnp.asarray(temps), self.rng)
+        next_host = np.asarray(next_tokens)  # device sync point
+        step_s = time.time() - start
+        self._obs.hist("app_tpu_execute_seconds", step_s)
+
+        n_active = 0
+        for i, slot in enumerate(self.slots):
+            if not slot.active:
+                continue
+            n_active += 1
+            token = int(next_host[i])
+            request = slot.request
+            slot.length += 1
+            slot.remaining -= 1
+            self._cur_tokens[i] = token
+            self._emit(request, token)
+            self._obs.hist("app_tpu_tpot_seconds", step_s)
+            if (token in request.stop_tokens or slot.remaining <= 0
+                    or request.cancelled.is_set()
+                    or slot.length >= self.max_seq_len - 1):
+                self._finish_slot(slot)
+        self._obs.hist("app_tpu_batch_size", n_active)
+        self._track_throughput(n_active)
+
+    def _emit(self, request: GenerationRequest, token: int) -> None:
+        request.generated += 1
+        request.out_queue.put(token)
+        self._obs.counter("app_tpu_tokens_generated_total")
+
+    def _finish_slot(self, slot: _Slot) -> None:
+        request = slot.request
+        slot.request = None
+        slot.length = 0
+        slot.remaining = 0
+        if request is not None:
+            request.finished_at = time.time()
+            request.out_queue.put(None)
+        self._obs.gauge("app_tpu_active_slots",
+                            sum(1 for s in self.slots if s.active))
+
+    def _reset_device_state(self, exc: BaseException) -> None:
+        """Rebuild the KV cache after a failed donated-cache program
+        (donation means the old buffers may be deleted on TPU/GPU) and fail
+        every active request, whose cached context no longer exists."""
+        for slot in self.slots:
+            if slot.active:
+                slot.request.error = exc
+                self._finish_slot(slot)
+        self.k_cache, self.v_cache = init_kv_cache(self.cfg, self.n_slots,
+                                                   self.max_seq_len)
+
+    def _drain_pending(self, exc: BaseException) -> None:
+        while True:
+            try:
+                request = self._pending.get_nowait()
+            except queue.Empty:
+                return
+            request.error = exc
+            request.out_queue.put(None)
+
+    def _track_throughput(self, tokens: int) -> None:
+        now = time.time()
+        self._tok_window.append((now, tokens))
+        cutoff = now - 5.0
+        while self._tok_window and self._tok_window[0][0] < cutoff:
+            self._tok_window.pop(0)
+        if len(self._tok_window) >= 2:
+            span = now - self._tok_window[0][0]
+            total = sum(t for _, t in self._tok_window)
+            if span > 0:
+                self._obs.gauge("app_tpu_tokens_per_second", total / span)
+

@@ -1,0 +1,153 @@
+"""Executor: AOT compile cache keyed by (program, shapes), shape bucketing.
+
+The TPU-first design constraint this enforces (SURVEY.md §7 hard parts):
+everything under jit is traced once and compiled; dynamic request shapes must
+be bucketed to a small, fixed set so XLA compiles a bounded number of
+programs. The cache is the analog of the reference keeping its expensive init
+(DB connect) in the container, not per request (gofr.go:63-97).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+DEFAULT_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096, 8192)
+
+
+def next_bucket(n: int, buckets: Sequence[int] = DEFAULT_BUCKETS) -> int:
+    """Smallest bucket >= n. Raises if n exceeds the largest bucket."""
+    for b in buckets:
+        if n <= b:
+            return b
+    raise ValueError(f"size {n} exceeds largest bucket {buckets[-1]}")
+
+
+def pad_to(array, size: int, axis: int = 0, value=0):
+    """Pad `array` along `axis` up to `size` with `value` (no-op if already there)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    xp = jnp if not isinstance(array, np.ndarray) else np
+    current = array.shape[axis]
+    if current == size:
+        return array
+    if current > size:
+        raise ValueError(f"array dim {current} larger than target {size}")
+    widths = [(0, 0)] * array.ndim
+    widths[axis] = (0, size - current)
+    return xp.pad(array, widths, constant_values=value)
+
+
+def _abstract_key(tree) -> Tuple:
+    """Hashable (shape, dtype) signature of an argument pytree."""
+    import jax
+
+    leaves = jax.tree_util.tree_leaves(tree)
+    sig = []
+    for leaf in leaves:
+        if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+            sig.append((tuple(leaf.shape), str(leaf.dtype)))
+        else:
+            sig.append((type(leaf).__name__, repr(leaf)))
+    return tuple(sig)
+
+
+class CompiledProgram:
+    def __init__(self, compiled, name: str, key: Tuple):
+        self.compiled = compiled
+        self.name = name
+        self.key = key
+        self.executions = 0
+
+    def __call__(self, *args):
+        self.executions += 1
+        return self.compiled(*args)
+
+
+class Executor:
+    """Compile-once execute-many wrapper around jax.jit with an explicit cache.
+
+    compile(name, fn, args, ...) AOT-lowers + compiles for the exact arg
+    shapes; subsequent calls with the same shapes hit the cache. `run` is the
+    one-call convenience: bucket -> compile-or-hit -> execute.
+    """
+
+    def __init__(self, tpu_client=None, logger=None, metrics=None):
+        self.tpu = tpu_client
+        self.logger = logger if logger is not None else getattr(tpu_client, "logger", None)
+        self.metrics = metrics if metrics is not None else getattr(tpu_client, "metrics", None)
+        self._cache: Dict[Tuple, CompiledProgram] = {}
+        self._lock = threading.Lock()
+
+    def _observe_compile(self, name: str, seconds: float, hit: bool) -> None:
+        if self.metrics is not None:
+            try:
+                if hit:
+                    self.metrics.increment_counter("app_tpu_compile_cache_hits")
+                else:
+                    self.metrics.increment_counter("app_tpu_compile_total")
+            except Exception:  # noqa: BLE001 - metrics may not be registered in tests
+                pass
+        if not hit and self.logger is not None:
+            self.logger.infof("compiled %s in %.2fs", name, seconds)
+
+    def compile(self, name: str, fn: Callable, args: Tuple,
+                static_argnums: Tuple[int, ...] = (),
+                donate_argnums: Tuple[int, ...] = (),
+                in_shardings=None, out_shardings=None) -> CompiledProgram:
+        import jax
+
+        key = (name, _abstract_key([a for i, a in enumerate(args) if i not in static_argnums]),
+               tuple(static_argnums), tuple(donate_argnums))
+        with self._lock:
+            cached = self._cache.get(key)
+        if cached is not None:
+            self._observe_compile(name, 0.0, hit=True)
+            return cached
+
+        start = time.time()
+        kwargs: Dict[str, Any] = {}
+        if static_argnums:
+            kwargs["static_argnums"] = static_argnums
+        if donate_argnums:
+            kwargs["donate_argnums"] = donate_argnums
+        if in_shardings is not None:
+            kwargs["in_shardings"] = in_shardings
+        if out_shardings is not None:
+            kwargs["out_shardings"] = out_shardings
+        jitted = jax.jit(fn, **kwargs)
+        compiled = jitted.lower(*args).compile()
+        program = CompiledProgram(compiled, name, key)
+        elapsed = time.time() - start
+        with self._lock:
+            # a racing thread may have compiled the same key; keep the first
+            program = self._cache.setdefault(key, program)
+        self._observe_compile(name, elapsed, hit=False)
+        return program
+
+    def run(self, name: str, fn: Callable, *args, **compile_kwargs):
+        program = self.compile(name, fn, args, **compile_kwargs)
+        start = time.time()
+        out = program(*args)
+        if self.metrics is not None:
+            try:
+                self.metrics.increment_counter("app_tpu_execute_total")
+                self.metrics.record_histogram("app_tpu_execute_seconds", time.time() - start)
+            except Exception:  # noqa: BLE001
+                pass
+        return out
+
+    def warmup(self, name: str, fn: Callable, example_args: Tuple, **kw) -> None:
+        """Pre-compile at boot so the first request doesn't pay compile latency
+        (the expensive-init-in-container rule, SURVEY.md §3.1)."""
+        self.compile(name, fn, example_args, **kw)
+
+    @property
+    def cache_size(self) -> int:
+        return len(self._cache)
+
+    def cache_info(self) -> Dict[str, int]:
+        with self._lock:
+            return {prog.name: prog.executions for prog in self._cache.values()}

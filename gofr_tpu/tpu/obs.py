@@ -1,0 +1,33 @@
+"""Shared best-effort metric recording for the TPU runtime components.
+
+Metric failures (unregistered name in a bare test Manager, etc.) must never
+take down the serving loop, so every call swallows errors.
+"""
+
+from __future__ import annotations
+
+
+class MetricsHook:
+    def __init__(self, metrics=None):
+        self.metrics = metrics
+
+    def counter(self, name: str, value: float = 1.0, **labels) -> None:
+        if self.metrics is not None:
+            try:
+                self.metrics.increment_counter(name, value, **labels)
+            except Exception:  # noqa: BLE001
+                pass
+
+    def gauge(self, name: str, value, **labels) -> None:
+        if self.metrics is not None:
+            try:
+                self.metrics.set_gauge(name, value, **labels)
+            except Exception:  # noqa: BLE001
+                pass
+
+    def hist(self, name: str, value, **labels) -> None:
+        if self.metrics is not None:
+            try:
+                self.metrics.record_histogram(name, value, **labels)
+            except Exception:  # noqa: BLE001
+                pass

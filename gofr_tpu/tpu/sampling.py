@@ -1,0 +1,40 @@
+"""On-device token sampling: greedy / temperature / top-k / top-p.
+
+All branches are compiled into one program (lax.cond-free masking) so the
+decode step stays a single XLA executable regardless of per-request settings:
+temperature==0 rows take the argmax path via jnp.where.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def sample_tokens(logits, rng, temperature, top_k: int = 0, top_p: float = 0.0):
+    """logits: [B, V] float32; temperature: [B] float32 (0 => greedy);
+    top_k: static int (0 disables); top_p: static float (0 disables).
+    Returns ([B] int32 tokens, new rng)."""
+    B, V = logits.shape
+    rng, sub = jax.random.split(rng)
+
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    scaled = logits / jnp.maximum(temperature, 1e-6)[:, None]
+
+    if top_k and top_k > 0:
+        kth = jax.lax.top_k(scaled, top_k)[0][:, -1:]  # [B, 1]
+        scaled = jnp.where(scaled < kth, -1e30, scaled)
+
+    if top_p and top_p > 0.0:
+        sorted_logits = jnp.sort(scaled, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cumulative = jnp.cumsum(probs, axis=-1)
+        # keep the smallest prefix with cumulative mass >= top_p
+        cutoff_idx = jnp.sum(cumulative < top_p, axis=-1, keepdims=True)
+        cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx, axis=-1)
+        scaled = jnp.where(scaled < cutoff, -1e30, scaled)
+
+    sampled = jax.random.categorical(sub, scaled, axis=-1).astype(jnp.int32)
+    tokens = jnp.where(temperature <= 0.0, greedy, sampled)
+    return tokens, rng

@@ -1,0 +1,176 @@
+"""Dynamic batching scheduler: time/size-windowed batch assembly over a queue.
+
+This is the layer-7 runtime from SURVEY.md §1's TPU mapping: HTTP/gRPC/pub-sub
+handlers enqueue {input, future} and block on the future (the reference's
+per-request-goroutine model, handler.go:58-63, maps to a thread waiting on a
+Future); the scheduler's device loop assembles padded batches and demuxes
+results. Batch dim is padded to power-of-two buckets to bound XLA compilation
+count; sequence dim likewise when `seq_axis` is set.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from typing import Callable, Optional, Sequence
+
+from .executor import Executor, next_bucket, pad_to
+from .obs import MetricsHook
+
+BATCH_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256)
+
+
+class _WorkItem:
+    __slots__ = ("payload", "future", "enqueued_at")
+
+    def __init__(self, payload):
+        self.payload = payload
+        self.future: Future = Future()
+        self.enqueued_at = time.time()
+
+
+class DynamicBatcher:
+    """Batches single-example payloads into padded model calls.
+
+    model_fn(batch) -> batch of outputs. Payloads are numpy/JAX arrays whose
+    leading axis is the example (so a payload of shape [T, ...] becomes row b
+    of a [B, T, ...] batch). When examples vary along `seq_axis`, each is
+    padded to the batch's sequence bucket.
+    """
+
+    def __init__(
+        self,
+        model_fn: Callable,
+        executor: Optional[Executor] = None,
+        max_batch: int = 32,
+        window_s: float = 0.005,
+        batch_buckets: Sequence[int] = BATCH_BUCKETS,
+        seq_axis: Optional[int] = None,
+        seq_buckets: Sequence[int] = (16, 32, 64, 128, 256, 512, 1024, 2048),
+        pad_value=0,
+        name: str = "dynamic-batcher",
+        metrics=None,
+        logger=None,
+    ):
+        self.model_fn = model_fn
+        self.executor = executor or Executor()
+        self.max_batch = max_batch
+        self.window_s = window_s
+        self.batch_buckets = tuple(b for b in batch_buckets if b <= max_batch) or (max_batch,)
+        self.seq_axis = seq_axis
+        self.seq_buckets = seq_buckets
+        self.pad_value = pad_value
+        self.name = name
+        self.metrics = metrics if metrics is not None else self.executor.metrics
+        self.logger = logger
+        self._obs = MetricsHook(self.metrics)
+        self._queue: "queue.Queue[_WorkItem]" = queue.Queue()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- ingress --------------------------------------------------------------
+    def submit(self, payload) -> Future:
+        if self._stop.is_set():
+            raise RuntimeError("batcher is stopped")
+        if self.seq_axis is not None and hasattr(payload, "shape"):
+            # reject oversized payloads here so one bad request can't fail
+            # the whole co-assembled batch in _run_batch
+            seq_len = payload.shape[self.seq_axis]
+            if seq_len > self.seq_buckets[-1]:
+                raise ValueError(f"sequence of {seq_len} exceeds the largest "
+                                 f"bucket ({self.seq_buckets[-1]})")
+        item = _WorkItem(payload)
+        self._queue.put(item)
+        self._obs.gauge("app_tpu_queue_depth", self._queue.qsize())
+        return item.future
+
+    def infer(self, payload, timeout_s: Optional[float] = None):
+        """Blocking convenience: submit and wait (what HTTP handlers call)."""
+        return self.submit(payload).result(timeout=timeout_s)
+
+    # -- lifecycle ------------------------------------------------------------
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop, name=self.name, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        while True:  # fail anything still queued
+            try:
+                item = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if not item.future.done():
+                item.future.set_exception(RuntimeError("batcher stopped"))
+
+    # -- device loop ----------------------------------------------------------
+    def _collect(self) -> list:
+        """Block for the first item, then fill the batch inside the window."""
+        try:
+            first = self._queue.get(timeout=0.1)
+        except queue.Empty:
+            return []
+        items = [first]
+        deadline = time.time() + self.window_s
+        while len(items) < self.max_batch:
+            remaining = deadline - time.time()
+            if remaining <= 0:
+                break
+            try:
+                items.append(self._queue.get(timeout=remaining))
+            except queue.Empty:
+                break
+        return items
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            items = self._collect()
+            if not items:
+                continue
+            try:
+                self._run_batch(items)
+            except Exception as exc:  # noqa: BLE001 - fail the batch, keep serving
+                if self.logger is not None:
+                    self.logger.errorf("batch failed: %s", exc)
+                for item in items:
+                    if not item.future.done():
+                        item.future.set_exception(exc)
+
+    def _run_batch(self, items: list) -> None:
+        import jax.numpy as jnp
+        import numpy as np
+
+        n = len(items)
+        bucket = next_bucket(n, self.batch_buckets)
+        payloads = [item.payload for item in items]
+
+        if self.seq_axis is not None:
+            max_len = max(p.shape[self.seq_axis] for p in payloads)
+            seq_bucket = next_bucket(max_len, self.seq_buckets)
+            payloads = [pad_to(p, seq_bucket, axis=self.seq_axis, value=self.pad_value)
+                        for p in payloads]
+
+        batch = np.stack([np.asarray(p) for p in payloads])
+        if bucket > n:  # pad batch dim with copies of row 0 (cheap, discarded)
+            fill = np.broadcast_to(batch[:1], (bucket - n,) + batch.shape[1:])
+            batch = np.concatenate([batch, fill], axis=0)
+
+        outputs = self.executor.run(self.name, self.model_fn, jnp.asarray(batch))
+
+        self._obs.hist("app_tpu_batch_size", n)
+        self._obs.gauge("app_tpu_queue_depth", self._queue.qsize())
+        outputs = np.asarray(outputs)
+        now = time.time()
+        for i, item in enumerate(items):
+            if not item.future.done():
+                item.future.set_result(outputs[i])
+            self._obs.hist("app_tpu_ttft_seconds", now - item.enqueued_at)
+

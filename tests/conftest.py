@@ -8,12 +8,23 @@ virtual devices so multi-chip sharding paths are exercised for real.
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# The environment's sitecustomize registers the real-TPU PJRT plugin and
+# force-sets jax_platforms="axon,cpu" (overriding the env var above). Tests
+# must never touch the single-tenant TPU tunnel — re-pin the config to cpu
+# AFTER jax import; backends initialize lazily, so this wins.
+import jax  # noqa: E402
+
+try:
+    jax.config.update("jax_platforms", "cpu")
+except Exception:  # noqa: BLE001 - plain environments have no override to undo
+    pass
 
 import pytest  # noqa: E402
 

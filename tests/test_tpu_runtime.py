@@ -1,0 +1,253 @@
+"""TPU runtime tier: device client, executor cache, dynamic batcher, LLM engine.
+
+Runs on the virtual CPU backend (conftest) — real compile/execute semantics,
+no hardware, per SURVEY.md §4's fake-backend lesson.
+"""
+
+import threading
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gofr_tpu.config import MockConfig
+from gofr_tpu.logging import MockLogger
+from gofr_tpu.metrics import Manager
+from gofr_tpu.tpu.device import TPUClient
+from gofr_tpu.tpu.executor import Executor, next_bucket, pad_to
+from gofr_tpu.tpu.scheduler import DynamicBatcher
+
+
+def make_metrics():
+    m = Manager()
+    client = TPUClient(MockConfig({}))
+    client.use_metrics(m)
+    client.use_logger(MockLogger())
+    client.connect()
+    return m, client
+
+
+# -- device client ------------------------------------------------------------
+def test_tpu_client_connect_and_health():
+    metrics, client = make_metrics()
+    assert client.device_count == 8  # virtual CPU mesh from conftest
+    health = client.health_check()
+    assert health.status == "UP"
+    assert health.details["devices"] == 8
+    assert "app_tpu_ttft_seconds" in metrics.expose()
+
+
+def test_tpu_client_mesh():
+    _, client = make_metrics()
+    mesh = client.mesh({"dp": 2, "tp": 4})
+    assert mesh.shape == {"dp": 2, "tp": 4}
+    mesh = client.mesh({"dp": -1, "tp": 2})
+    assert mesh.shape == {"dp": 4, "tp": 2}
+    with pytest.raises(ValueError):
+        client.mesh({"dp": 3, "tp": 3})
+
+
+# -- bucketing ----------------------------------------------------------------
+def test_next_bucket_and_pad():
+    assert next_bucket(1) == 1
+    assert next_bucket(5) == 8
+    assert next_bucket(8) == 8
+    with pytest.raises(ValueError):
+        next_bucket(10**9)
+    x = np.ones((3, 4))
+    padded = pad_to(x, 8, axis=0)
+    assert padded.shape == (8, 4)
+    assert padded[3:].sum() == 0
+    assert pad_to(x, 4, axis=1).shape == (3, 4)
+    with pytest.raises(ValueError):
+        pad_to(x, 2, axis=0)
+
+
+# -- executor -----------------------------------------------------------------
+def test_executor_compile_cache():
+    metrics, client = make_metrics()
+    ex = Executor(client)
+
+    def f(x):
+        return x * 2.0
+
+    a = jnp.ones((4, 4))
+    out1 = ex.run("double", f, a)
+    out2 = ex.run("double", f, a)
+    np.testing.assert_allclose(np.asarray(out1), np.asarray(out2))
+    assert ex.cache_size == 1
+    # different shape -> new compile
+    ex.run("double", f, jnp.ones((8, 4)))
+    assert ex.cache_size == 2
+    text = metrics.expose()
+    assert "app_tpu_compile_total 2.0" in text
+    assert "app_tpu_compile_cache_hits 1.0" in text
+
+
+def test_executor_donation():
+    ex = Executor()
+
+    def step(state):
+        return state + 1.0
+
+    state = jnp.zeros((16,))
+    program = ex.compile("step", step, (state,), donate_argnums=(0,))
+    state = program(state)
+    state = program(state)
+    assert float(state[0]) == 2.0
+
+
+# -- dynamic batcher ----------------------------------------------------------
+def test_batcher_batches_and_demuxes():
+    metrics, client = make_metrics()
+    ex = Executor(client)
+
+    seen_batches = []
+
+    def model(batch):  # [B, D] -> [B]
+        seen_batches.append(batch.shape)
+        return jnp.sum(batch, axis=-1)
+
+    batcher = DynamicBatcher(model, executor=ex, max_batch=8, window_s=0.05,
+                             name="sum")
+    batcher.start()
+    try:
+        futures = [batcher.submit(np.full((4,), float(i))) for i in range(5)]
+        results = [f.result(timeout=30) for f in futures]
+        assert [float(r) for r in results] == [0.0, 4.0, 8.0, 12.0, 16.0]
+        # 5 requests -> one padded batch of 8 (bucket), not 5 separate calls
+        assert all(shape[0] in (1, 2, 4, 8) for shape in seen_batches)
+        assert len(seen_batches) <= 3
+    finally:
+        batcher.stop()
+
+
+def test_batcher_variable_seq_padding():
+    ex = Executor()
+
+    def model(batch):  # [B, T] -> [B]
+        return jnp.sum(batch, axis=-1)
+
+    batcher = DynamicBatcher(model, executor=ex, max_batch=4, window_s=0.05,
+                             seq_axis=0, seq_buckets=(8, 16), name="varlen")
+    batcher.start()
+    try:
+        f1 = batcher.submit(np.ones((3,)))
+        f2 = batcher.submit(np.ones((7,)))
+        assert float(f1.result(timeout=30)) == 3.0
+        assert float(f2.result(timeout=30)) == 7.0
+    finally:
+        batcher.stop()
+
+
+def test_batcher_model_error_fails_futures():
+    ex = Executor()
+
+    def model(batch):
+        raise RuntimeError("device on fire")
+
+    batcher = DynamicBatcher(model, executor=ex, max_batch=2, window_s=0.01)
+    batcher.start()
+    try:
+        future = batcher.submit(np.ones((2,)))
+        with pytest.raises(RuntimeError, match="device on fire"):
+            future.result(timeout=30)
+    finally:
+        batcher.stop()
+
+
+def test_batcher_stop_fails_queued():
+    ex = Executor()
+    batcher = DynamicBatcher(lambda b: b, executor=ex)
+    future = batcher.submit(np.ones((1,)))  # never started
+    batcher.stop()
+    with pytest.raises(RuntimeError):
+        future.result(timeout=5)
+    with pytest.raises(RuntimeError):
+        batcher.submit(np.ones((1,)))
+
+
+# -- LLM engine ---------------------------------------------------------------
+@pytest.fixture(scope="module")
+def engine():
+    from gofr_tpu.models.llama import LlamaConfig, llama_init
+    from gofr_tpu.tpu.engine import LLMEngine
+
+    cfg = LlamaConfig.debug()
+    params = llama_init(cfg, seed=0)
+    eng = LLMEngine(params, cfg, n_slots=4, max_seq_len=64,
+                    prefill_buckets=(8, 16), logger=MockLogger())
+    eng.start()
+    yield eng
+    eng.stop()
+
+
+def test_engine_generates_deterministically(engine):
+    prompt = [1, 2, 3, 4, 5]
+    out1 = engine.generate(prompt, max_new_tokens=8, temperature=0.0)
+    out2 = engine.generate(prompt, max_new_tokens=8, temperature=0.0)
+    assert len(out1) == 8
+    assert out1 == out2  # greedy is deterministic
+    assert all(0 <= t < engine.cfg.vocab_size for t in out1)
+
+
+def test_engine_matches_unbatched_reference(engine):
+    """Greedy engine output == step-by-step nocache reference decode."""
+    import jax.numpy as jnp
+
+    from gofr_tpu.models.llama import llama_forward_nocache
+
+    prompt = [3, 1, 4, 1, 5]
+    got = engine.generate(prompt, max_new_tokens=6, temperature=0.0)
+
+    seq = list(prompt)
+    for _ in range(6):
+        logits = llama_forward_nocache(engine.params, engine.cfg,
+                                       jnp.asarray([seq], dtype=jnp.int32))
+        seq.append(int(np.asarray(jnp.argmax(logits[0, -1]))))
+    assert got == seq[len(prompt):]
+
+
+def test_engine_concurrent_requests(engine):
+    """More requests than slots: continuous batching must serve them all."""
+    results = {}
+
+    def run(i):
+        results[i] = engine.generate([i + 1, i + 2], max_new_tokens=5,
+                                     temperature=0.0)
+
+    threads = [threading.Thread(target=run, args=(i,)) for i in range(7)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert len(results) == 7
+    assert all(len(v) == 5 for v in results.values())
+    # same prompt -> same output regardless of slot/batch interleaving
+    check = engine.generate([1, 2], max_new_tokens=5, temperature=0.0)
+    assert results[0] == check
+
+
+def test_engine_stop_tokens(engine):
+    prompt = [1, 2, 3]
+    free_run = engine.generate(prompt, max_new_tokens=8, temperature=0.0)
+    stopped = engine.generate(prompt, max_new_tokens=8, temperature=0.0,
+                              stop_tokens={free_run[2]})
+    assert stopped == free_run[:3]  # stop token is emitted, then generation ends
+
+
+def test_engine_streaming(engine):
+    request = engine.submit([5, 6, 7], max_new_tokens=4, temperature=0.0)
+    tokens = []
+    for token in request.stream(timeout_s=60):
+        tokens.append(token)
+    assert len(tokens) == 4
+    assert request.finished_at is not None
+
+
+def test_engine_rejects_bad_prompts(engine):
+    with pytest.raises(ValueError):
+        engine.submit([])
+    with pytest.raises(ValueError):
+        engine.submit(list(range(100)))  # exceeds largest prefill bucket (16)
