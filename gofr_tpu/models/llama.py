@@ -239,6 +239,25 @@ def llama_decode_step(params, cfg: LlamaConfig, tokens, positions, k_cache, v_ca
     return logits[:, 0, :], k_cache, v_cache
 
 
+def _attention_block_nocache(x, layer, positions, cfg: LlamaConfig):
+    """Plain causal attention sublayer (no cache). x: [B, T, D] -> [B, T, D]."""
+    B, T, _ = x.shape
+    H, Hkv, dh, G = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim, cfg.q_per_kv
+    causal = jnp.tril(jnp.ones((T, T), dtype=bool))
+    normed = rms_norm(x, layer["attn_norm"], cfg.rms_eps)
+    q = rope((normed @ layer["wq"]).reshape(B, T, H, dh), positions, cfg.rope_theta)
+    k = rope((normed @ layer["wk"]).reshape(B, T, Hkv, dh), positions, cfg.rope_theta)
+    v = (normed @ layer["wv"]).reshape(B, T, Hkv, dh)
+    qg = q.reshape(B, T, Hkv, G, dh)
+    scores = jnp.einsum("bthgd,bshd->bhgts", qg.astype(jnp.float32),
+                        k.astype(jnp.float32)) / math.sqrt(dh)
+    scores = jnp.where(causal[None, None, None, :, :], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    attn = jnp.einsum("bhgts,bshd->bthgd", probs,
+                      v.astype(jnp.float32)).astype(x.dtype)
+    return attn.reshape(B, T, H * dh) @ layer["wo"]
+
+
 def llama_forward_nocache(params, cfg: LlamaConfig, tokens):
     """Training/eval forward without a cache: plain causal attention.
 
@@ -248,22 +267,9 @@ def llama_forward_nocache(params, cfg: LlamaConfig, tokens):
     B, T = tokens.shape
     positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None, :], (B, T))
     x = params["tok_emb"][tokens]
-    H, Hkv, dh, G = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim, cfg.q_per_kv
-    causal = jnp.tril(jnp.ones((T, T), dtype=bool))
 
     def body(x, layer):
-        normed = rms_norm(x, layer["attn_norm"], cfg.rms_eps)
-        q = rope((normed @ layer["wq"]).reshape(B, T, H, dh), positions, cfg.rope_theta)
-        k = rope((normed @ layer["wk"]).reshape(B, T, Hkv, dh), positions, cfg.rope_theta)
-        v = (normed @ layer["wv"]).reshape(B, T, Hkv, dh)
-        qg = q.reshape(B, T, Hkv, G, dh)
-        scores = jnp.einsum("bthgd,bshd->bhgts", qg.astype(jnp.float32),
-                            k.astype(jnp.float32)) / math.sqrt(dh)
-        scores = jnp.where(causal[None, None, None, :, :], scores, -1e30)
-        probs = jax.nn.softmax(scores, axis=-1)
-        attn = jnp.einsum("bhgts,bshd->bthgd", probs,
-                          v.astype(jnp.float32)).astype(x.dtype)
-        x = x + attn.reshape(B, T, H * dh) @ layer["wo"]
+        x = x + _attention_block_nocache(x, layer, positions, cfg)
         x = x + _ffn_block(x, layer, cfg)
         return x, None
 

@@ -1,0 +1,5 @@
+"""Hot-path ops: ring attention, (pallas kernels live here as they land)."""
+
+from .ring_attention import ring_attention
+
+__all__ = ["ring_attention"]

@@ -1,0 +1,74 @@
+"""Mesh construction: named axes over the device slice.
+
+Axis order encodes ICI locality: "tp" is innermost (most-frequent, smallest
+collectives ride the fastest links), then "sp", then "pp", then "dp"
+outermost (gradient all-reduce once per step tolerates DCN).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+AXES = ("dp", "pp", "sp", "tp", "ep")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshPlan:
+    dp: int = 1
+    pp: int = 1
+    sp: int = 1
+    tp: int = 1
+    ep: int = 1
+
+    @property
+    def n_devices(self) -> int:
+        return self.dp * self.pp * self.sp * self.tp * self.ep
+
+    def axis_sizes(self) -> Dict[str, int]:
+        return {"dp": self.dp, "pp": self.pp, "sp": self.sp, "tp": self.tp,
+                "ep": self.ep}
+
+    @classmethod
+    def factorize(cls, n: int, prefer=("tp", "sp", "dp")) -> "MeshPlan":
+        """Split n devices over the preferred axes, powers of two first.
+
+        Default preference matches single-model serving/training: fill tp
+        (fastest links, per-layer collectives), then sp (long context), then
+        dp. E.g. 8 -> tp=2, sp=2, dp=2; 4 -> tp=2, sp=2; 2 -> tp=2.
+        """
+        sizes = {axis: 1 for axis in AXES}
+        remaining = n
+        idx = 0
+        while remaining > 1:
+            axis = prefer[idx % len(prefer)]
+            if remaining % 2 == 0:
+                sizes[axis] *= 2
+                remaining //= 2
+            else:
+                sizes[axis] *= remaining  # odd leftover goes to current axis
+                remaining = 1
+            idx += 1
+        return cls(**sizes)
+
+
+def make_mesh(plan: Optional[MeshPlan] = None, devices: Optional[List] = None,
+              **axis_sizes: int):
+    """Build a Mesh for `plan` (or explicit axis sizes) over `devices`.
+
+    All five axes are always present (size-1 axes are free), so sharding
+    rules can reference any axis regardless of the deployed topology.
+    """
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    if plan is None:
+        plan = MeshPlan(**{k: int(v) for k, v in axis_sizes.items()})
+    if devices is None:
+        devices = jax.devices()
+    if plan.n_devices != len(devices):
+        raise ValueError(f"plan {plan} needs {plan.n_devices} devices, "
+                         f"have {len(devices)}")
+    shape = tuple(plan.axis_sizes()[a] for a in AXES)
+    return Mesh(np.array(devices).reshape(shape), AXES)

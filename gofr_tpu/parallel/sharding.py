@@ -1,0 +1,82 @@
+"""Sharding rules: PartitionSpecs for model params and batches.
+
+Megatron-style tensor parallelism for the Llama block: attention heads and
+FFN hidden dim shard over "tp" (column-parallel wq/wk/wv/gate/up, row-parallel
+wo/down — XLA inserts the reduce-scatter/all-gather pairs), vocab shards the
+embedding/lm_head over "tp", the stacked layer axis shards over "pp", MoE
+expert axis over "ep". Batches shard [B, T] as ("dp", "sp") — sequence
+parallelism for long context; the attention implementation decides whether
+the sp collectives are all-gather (XLA auto) or a ring (ops/ring_attention).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+
+def _P(*names):
+    from jax.sharding import PartitionSpec
+
+    return PartitionSpec(*names)
+
+
+def llama_param_specs(moe: bool = False) -> Dict[str, Any]:
+    """PartitionSpec pytree matching llama_init's params structure."""
+    layers = {
+        # [L, D, H*dh]: heads are column-parallel over tp; L pipelines over pp
+        "wq": _P("pp", None, "tp"),
+        "wk": _P("pp", None, "tp"),
+        "wv": _P("pp", None, "tp"),
+        # [L, H*dh, D]: row-parallel (contraction dim sharded)
+        "wo": _P("pp", "tp", None),
+        "w_gate": _P("pp", None, "tp"),
+        "w_up": _P("pp", None, "tp"),
+        "w_down": _P("pp", "tp", None),
+        "attn_norm": _P("pp", None),
+        "ffn_norm": _P("pp", None),
+    }
+    if moe:
+        layers.update({
+            # router [L, D, E] replicated over tp (tiny); experts over ep
+            "w_router": _P("pp", None, None),
+            # [L, E, D, F]
+            "w_gate": _P("pp", "ep", None, "tp"),
+            "w_up": _P("pp", "ep", None, "tp"),
+            "w_down": _P("pp", "ep", "tp", None),
+        })
+    return {
+        "tok_emb": _P("tp", None),   # vocab-sharded embedding
+        "layers": layers,
+        "final_norm": _P(None),
+        "lm_head": _P(None, "tp"),   # column-parallel output projection
+    }
+
+
+def batch_spec():
+    """Token batches [B, T]: batch over dp, sequence over sp."""
+    return _P("dp", "sp")
+
+
+def shard_params(params, mesh, specs=None):
+    """device_put the params pytree onto the mesh with NamedSharding.
+
+    Downstream jits need no explicit in_shardings — committed input shardings
+    propagate and XLA inserts the collectives (the scaling-book recipe).
+    """
+    import jax
+    from jax.sharding import NamedSharding
+
+    if specs is None:
+        specs = llama_param_specs()
+
+    def place(leaf, spec):
+        return jax.device_put(leaf, NamedSharding(mesh, spec))
+
+    return jax.tree_util.tree_map(place, params, specs)
+
+
+def unsharded_like(tree):
+    """Fully-replicated specs with the same structure (for small states)."""
+    import jax
+
+    return jax.tree_util.tree_map(lambda _: _P(), tree)
