@@ -5,10 +5,12 @@ for all layers, fast compiles, pipeline-shardable), bfloat16 params with
 float32 softmax/norm accumulation, static shapes everywhere.
 """
 
+from .bert import BertConfig, bert_embed, bert_encode, bert_init, bert_pool_cls
 from .llama import LlamaConfig, llama_decode_step, llama_forward, llama_init, llama_prefill
 from .mlp import MLPConfig, mlp_forward, mlp_init
 
 __all__ = [
+    "BertConfig", "bert_embed", "bert_encode", "bert_init", "bert_pool_cls",
     "LlamaConfig", "llama_decode_step", "llama_forward", "llama_init",
     "llama_prefill", "MLPConfig", "mlp_forward", "mlp_init",
 ]
