@@ -61,6 +61,8 @@ def build_app(app: App = None) -> App:
                 raise InvalidParam(["tokens"])
             if tokens.ndim != 1 or tokens.size == 0 or tokens.size > max_len:
                 raise InvalidParam(["tokens"])
+            if (tokens < 1).any() or (tokens >= cfg.vocab_size).any():
+                raise InvalidParam(["tokens"])
         elif isinstance(body, dict) and "text" in body:
             tokens = _encode(str(body["text"]), max_len)
         else:

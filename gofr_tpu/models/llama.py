@@ -35,6 +35,10 @@ class LlamaConfig:
     rope_theta: float = 500000.0
     rms_eps: float = 1e-5
     dtype: str = "bfloat16"
+    # "xla" | "flash" — selects the attention impl for the NO-CACHE forward
+    # (training/eval); the cached serving path keeps its scatter+masked-read
+    # attention regardless (flash prefill over the cache is future work)
+    attn_impl: str = "xla"
 
     @property
     def head_dim(self) -> int:
@@ -242,19 +246,19 @@ def llama_decode_step(params, cfg: LlamaConfig, tokens, positions, k_cache, v_ca
 def _attention_block_nocache(x, layer, positions, cfg: LlamaConfig):
     """Plain causal attention sublayer (no cache). x: [B, T, D] -> [B, T, D]."""
     B, T, _ = x.shape
-    H, Hkv, dh, G = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim, cfg.q_per_kv
-    causal = jnp.tril(jnp.ones((T, T), dtype=bool))
+    H, Hkv, dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     normed = rms_norm(x, layer["attn_norm"], cfg.rms_eps)
     q = rope((normed @ layer["wq"]).reshape(B, T, H, dh), positions, cfg.rope_theta)
     k = rope((normed @ layer["wk"]).reshape(B, T, Hkv, dh), positions, cfg.rope_theta)
     v = (normed @ layer["wv"]).reshape(B, T, Hkv, dh)
-    qg = q.reshape(B, T, Hkv, G, dh)
-    scores = jnp.einsum("bthgd,bshd->bhgts", qg.astype(jnp.float32),
-                        k.astype(jnp.float32)) / math.sqrt(dh)
-    scores = jnp.where(causal[None, None, None, :, :], scores, -1e30)
-    probs = jax.nn.softmax(scores, axis=-1)
-    attn = jnp.einsum("bhgts,bshd->bthgd", probs,
-                      v.astype(jnp.float32)).astype(x.dtype)
+    if cfg.attn_impl == "flash":
+        from ..ops.flash_attention import flash_attention
+
+        attn = flash_attention(q, k, v, True)
+    else:
+        from ..ops.flash_attention import attention_reference
+
+        attn = attention_reference(q, k, v, causal=True)
     return attn.reshape(B, T, H * dh) @ layer["wo"]
 
 

@@ -1,5 +1,6 @@
-"""Hot-path ops: ring attention, (pallas kernels live here as they land)."""
+"""Hot-path ops: pallas flash attention + ring attention for long context."""
 
+from .flash_attention import attention_reference, flash_attention
 from .ring_attention import ring_attention
 
-__all__ = ["ring_attention"]
+__all__ = ["attention_reference", "flash_attention", "ring_attention"]

@@ -1,0 +1,118 @@
+"""Flash-attention kernel numerics vs the unblocked oracle.
+
+Runs the pallas kernel in interpret mode on CPU (the CI tier from SURVEY.md
+§4 — real kernel semantics, no TPU); the same code path compiles via Mosaic
+on hardware.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gofr_tpu.ops.flash_attention import attention_reference, flash_attention
+
+
+def _rand(key, *shape):
+    return jax.random.normal(key, shape, dtype=jnp.float32)
+
+
+def _make_qkv(seed, B, T, S, H, Hkv, dh):
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(seed), 3)
+    return (_rand(k1, B, T, H, dh), _rand(k2, B, S, Hkv, dh),
+            _rand(k3, B, S, Hkv, dh))
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_matches_reference_mha(causal):
+    q, k, v = _make_qkv(0, 2, 64, 64, 4, 4, 32)
+    out = flash_attention(q, k, v, causal, 32, 32)
+    ref = attention_reference(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_matches_reference_gqa():
+    q, k, v = _make_qkv(1, 2, 48, 48, 8, 2, 32)
+    out = flash_attention(q, k, v, True, 16, 16)
+    ref = attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_ragged_lengths_padded_blocks():
+    """T and S not multiples of the block size exercise the padding masks."""
+    q, k, v = _make_qkv(2, 1, 37, 53, 2, 2, 32)
+    out = flash_attention(q, k, v, False, 16, 16)
+    ref = attention_reference(q, k, v, causal=False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_streaming_kernel_matches_reference(monkeypatch):
+    """Force the beyond-VMEM streaming kernel (kv grid axis + scratch carry)."""
+    import importlib
+
+    fa = importlib.import_module("gofr_tpu.ops.flash_attention")
+    monkeypatch.setattr(fa, "VMEM_KV_BUDGET_BYTES", 0)
+    q, k, v = _make_qkv(7, 2, 64, 64, 4, 2, 32)
+    out = fa.flash_attention(q, k, v, True, 32, 16)
+    ref = attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+    out_nc = fa.flash_attention(q, k, v, False, 32, 16)
+    ref_nc = attention_reference(q, k, v, causal=False)
+    np.testing.assert_allclose(np.asarray(out_nc), np.asarray(ref_nc),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_decode_shape_uses_exact_fallback():
+    """T=1 causal decode over an S-cache goes through the oracle path."""
+    q, k, v = _make_qkv(3, 2, 1, 40, 4, 2, 32)
+    out = flash_attention(q, k, v, True)
+    ref = attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_bf16_inputs_f32_accumulation():
+    q, k, v = _make_qkv(4, 1, 32, 32, 2, 2, 64)
+    qb, kb, vb = (x.astype(jnp.bfloat16) for x in (q, k, v))
+    out = flash_attention(qb, kb, vb, True, 16, 16)
+    assert out.dtype == jnp.bfloat16
+    ref = attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out, dtype=np.float32),
+                               np.asarray(ref), atol=3e-2, rtol=3e-2)
+
+
+def test_gradients_match_reference():
+    q, k, v = _make_qkv(5, 1, 32, 32, 4, 2, 32)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, True, 16, 16) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(attention_reference(q, k, v, causal=True) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-4, rtol=1e-4)
+
+
+def test_llama_forward_flash_matches_xla():
+    import dataclasses
+
+    from gofr_tpu.models.llama import (LlamaConfig, llama_forward_nocache,
+                                       llama_init)
+
+    cfg = LlamaConfig.debug()
+    params = llama_init(cfg, seed=0)
+    tokens = np.random.default_rng(0).integers(0, cfg.vocab_size, (2, 33))
+    tokens = jnp.asarray(tokens, dtype=jnp.int32)
+    base = llama_forward_nocache(params, cfg, tokens)
+    flash_cfg = dataclasses.replace(cfg, attn_impl="flash")
+    out = llama_forward_nocache(params, flash_cfg, tokens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(base),
+                               atol=2e-4, rtol=2e-4)
