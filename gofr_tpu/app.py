@@ -202,6 +202,15 @@ class App:
         tpu_client.connect()
         self.container.tpu = tpu_client
 
+    def add_document_store(self, store) -> None:
+        """Inject a document store (the Mongo provider pattern: New(config)
+        then UseLogger/UseMetrics/Connect, externalDB.go:5-12,
+        datasource/mongo.go:142-155)."""
+        store.use_logger(self.logger)
+        store.use_metrics(self.container.metrics_manager)
+        store.connect()
+        self.container.docstore = store
+
     def add_static_files(self, route_prefix: str, directory: str) -> None:
         self._static_dirs[route_prefix.rstrip("/")] = directory
 

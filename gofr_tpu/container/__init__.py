@@ -40,6 +40,7 @@ class Container:
         self.kv = None
         self.pubsub = None
         self.tpu = None
+        self.docstore = None
         self.services: Dict[str, Any] = {}
         self.app_name = config.get_or_default("APP_NAME", "gofr-tpu-app")
         self.app_version = config.get_or_default("APP_VERSION", "dev")
@@ -101,6 +102,7 @@ class Container:
         m.new_histogram("app_http_service_response", "outbound http call time in seconds", HTTP_BUCKETS)
         m.new_histogram("app_sql_stats", "sql query time in seconds", SQL_BUCKETS)
         m.new_histogram("app_kv_stats", "kv command time in seconds", KV_BUCKETS)
+        m.new_histogram("app_doc_stats", "document store op time in seconds", SQL_BUCKETS)
         m.new_counter("app_pubsub_publish_total_count", "messages published")
         m.new_counter("app_pubsub_subscribe_total_count", "messages received")
         m.new_counter("app_pubsub_commit_total_count", "messages committed")
@@ -143,7 +145,8 @@ class Container:
         details: Dict[str, Any] = {}
         statuses = []
         for name, source in (("sql", self.sql), ("kv", self.kv),
-                             ("pubsub", self.pubsub), ("tpu", self.tpu)):
+                             ("pubsub", self.pubsub), ("tpu", self.tpu),
+                             ("docstore", self.docstore)):
             if source is None:
                 continue
             try:
@@ -165,7 +168,7 @@ class Container:
         return out
 
     def close(self) -> None:
-        for source in (self.sql, self.pubsub, self.tpu):
+        for source in (self.sql, self.pubsub, self.tpu, self.docstore):
             if source is not None and hasattr(source, "close"):
                 try:
                     source.close()

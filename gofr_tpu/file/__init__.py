@@ -1,0 +1,116 @@
+"""File/zip utilities: in-memory zip extraction with a bomb guard.
+
+Parity: reference pkg/gofr/file/zip.go — NewZip reading an archive into
+memory (zip.go:24-56), a 100 MB decompression guard against zip bombs
+(zip.go:13-18,91-105), and CreateLocalCopies writing the extracted tree to
+disk (zip.go:58-89). Backs multipart file binding the same way the
+reference's file package backs http/multipartFileBind.go.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import zipfile
+from typing import Dict, Optional
+
+# zip.go:13-18 — hard cap on total decompressed bytes
+MAX_DECOMPRESSED_BYTES = 100 * 1024 * 1024
+
+
+class ZipBombError(ValueError):
+    """Total decompressed size exceeds the guard limit."""
+
+
+class File:
+    """One extracted archive member held in memory."""
+
+    def __init__(self, name: str, content: bytes):
+        self.name = name
+        self.content = content
+
+    @property
+    def size(self) -> int:
+        return len(self.content)
+
+    def bytes(self) -> bytes:
+        return self.content
+
+    def reader(self) -> io.BytesIO:
+        return io.BytesIO(self.content)
+
+
+class Zip:
+    """An in-memory extracted zip archive: name -> File.
+
+    Directory entries are skipped; member names are normalised so a
+    malicious `../` path can never escape the extraction root.
+    """
+
+    def __init__(self, files: Dict[str, File]):
+        self.files = files
+
+    @classmethod
+    def from_bytes(cls, data: bytes,
+                   max_bytes: int = MAX_DECOMPRESSED_BYTES) -> "Zip":
+        files: Dict[str, File] = {}
+        total = 0
+        with zipfile.ZipFile(io.BytesIO(data)) as archive:
+            for info in archive.infolist():
+                if info.is_dir():
+                    continue
+                # guard before reading: trust the header first, verify after
+                total += info.file_size
+                if total > max_bytes:
+                    raise ZipBombError(
+                        f"decompressed size exceeds {max_bytes} bytes")
+                content = archive.read(info)
+                if len(content) > info.file_size:
+                    total += len(content) - info.file_size
+                    if total > max_bytes:
+                        raise ZipBombError(
+                            f"decompressed size exceeds {max_bytes} bytes")
+                files[info.filename] = File(info.filename, content)
+        return cls(files)
+
+    @classmethod
+    def from_path(cls, path: str,
+                  max_bytes: int = MAX_DECOMPRESSED_BYTES) -> "Zip":
+        with open(path, "rb") as fp:
+            return cls.from_bytes(fp.read(), max_bytes=max_bytes)
+
+    def create_local_copies(self, dest_dir: str) -> None:
+        """Write every member under dest_dir (zip.go:58-89); path traversal
+        in member names is rejected rather than silently rewritten."""
+        root = os.path.abspath(dest_dir)
+        for name, file in self.files.items():
+            target = os.path.abspath(os.path.join(root, name))
+            if not target.startswith(root + os.sep) and target != root:
+                raise ValueError(f"zip member escapes destination: {name!r}")
+            os.makedirs(os.path.dirname(target), exist_ok=True)
+            with open(target, "wb") as fp:
+                fp.write(file.content)
+
+    def __len__(self) -> int:
+        return len(self.files)
+
+    def __contains__(self, name: str) -> bool:
+        return name in self.files
+
+    def __getitem__(self, name: str) -> File:
+        return self.files[name]
+
+
+def new_zip(data: bytes, max_bytes: int = MAX_DECOMPRESSED_BYTES) -> Zip:
+    """Reference-named constructor (zip.go:24)."""
+    return Zip.from_bytes(data, max_bytes=max_bytes)
+
+
+def zip_files(files: Dict[str, bytes]) -> bytes:
+    """Build a zip archive in memory from name -> content (test helper and
+    the write-side the reference leaves to archive/zip directly)."""
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as archive:
+        for name, content in files.items():
+            archive.writestr(name, content)
+    return buf.getvalue()

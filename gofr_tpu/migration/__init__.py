@@ -27,6 +27,7 @@ class Datasource:
         self.sql = tx if tx is not None else container.sql
         self.kv = container.kv
         self.pubsub = container.pubsub
+        self.docstore = getattr(container, "docstore", None)
         self.logger = container.logger
         self.tpu = container.tpu
 

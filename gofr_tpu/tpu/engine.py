@@ -1,4 +1,4 @@
-"""Continuous-batching LLM engine: slot-based decode with device-resident KV cache.
+"""Continuous-batching LLM engine: pipelined, chunked decode with device-resident state.
 
 The TPU-first shape of the problem (SURVEY.md §5 long-context + §7.5):
   - a fixed pool of `n_slots` sequences decodes in lock-step — one compiled
@@ -6,12 +6,27 @@ The TPU-first shape of the problem (SURVEY.md §5 long-context + §7.5):
   - the KV cache lives in HBM as [L, n_slots, S, Hkv, dh] and is DONATED to
     every prefill/decode call, so XLA updates it in place (no copy per token)
   - prefills are bucketed by prompt length (powers of two) to bound the
-    number of compiled programs; the padded tail of a prefill writes junk k/v
-    that is provably overwritten before it is ever attended to (slot index ==
-    absolute position and the mask is j <= q_pos)
+    number of compiled programs, and multiple admissions are fused into ONE
+    prefill dispatch ([K, bucket] prompts scattered into K slots, first token
+    sampled on device) — admission costs one host→device round-trip, not K
+  - the decode program runs `decode_block_size` steps under lax.scan per
+    dispatch, sampling on device each step and returning a [B, M] token
+    block; ALL loop state (current tokens, positions, temperatures, rng,
+    both caches) stays on device between dispatches
+  - up to `pipeline_depth` dispatches are kept in flight; the host syncs the
+    oldest block while the device executes the younger ones, so the
+    host↔device round-trip (large under the tunneled PJRT transport) and the
+    Python demux loop are fully overlapped with device compute
   - requests stream tokens out through per-request queues; new requests are
-    admitted into free slots between decode steps (continuous batching), so
-    short and long generations share the batch without head-of-line blocking
+    admitted into free slots between dispatches (continuous batching)
+
+Safety of speculative decode for freed slots: a freed slot keeps "decoding"
+junk inside already-dispatched blocks. Its junk tokens are discarded on sync
+(the slot's request identity changed), and its junk KV writes are harmless:
+every cache position is written by its current occupant before it is ever
+attended (the mask is j <= q_pos and decode writes position p before reading
+it), and out-of-range writes past the cache end are dropped by XLA scatter
+semantics.
 
 The reference's analog is the per-topic subscriber loop + per-request
 goroutine bridging (subscriber.go:27-57, handler.go:58-63); here the "broker"
@@ -20,11 +35,12 @@ is the admission queue and the "handler" is the decode loop.
 
 from __future__ import annotations
 
+import collections
 import itertools
 import queue
 import threading
 import time
-from typing import Any, Dict, Iterator, List, Optional, Sequence, Set
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Set, Tuple
 
 from ..models.llama import (LlamaConfig, init_kv_cache, llama_decode_step,
                             llama_forward)
@@ -95,6 +111,21 @@ class _Slot:
         return self.request is not None
 
 
+def _pow2_split(n: int, cap: int) -> List[int]:
+    """Decompose n into descending powers of two (each <= cap) so batched
+    prefill compiles a bounded set of K variants."""
+    out: List[int] = []
+    k = 1
+    while k * 2 <= cap:
+        k *= 2
+    while n > 0:
+        while k > n:
+            k //= 2
+        out.append(k)
+        n -= k
+    return out
+
+
 class LLMEngine:
     def __init__(
         self,
@@ -104,6 +135,8 @@ class LLMEngine:
         max_seq_len: Optional[int] = None,
         prefill_buckets: Sequence[int] = (16, 32, 64, 128, 256, 512, 1024),
         top_k: int = 0,
+        decode_block_size: int = 16,
+        pipeline_depth: int = 4,
         executor: Optional[Executor] = None,
         metrics=None,
         logger=None,
@@ -118,12 +151,14 @@ class LLMEngine:
         self.max_seq_len = min(max_seq_len or cfg.max_seq_len, cfg.max_seq_len)
         self.prefill_buckets = tuple(b for b in prefill_buckets if b <= self.max_seq_len)
         self.top_k = top_k
+        self.decode_block_size = max(1, decode_block_size)
+        self.pipeline_depth = max(1, pipeline_depth)
         self.executor = executor or Executor()
         self.metrics = metrics if metrics is not None else self.executor.metrics
         self.logger = logger
+        self._seed = seed
+        self._reset_counter = itertools.count(seed)
 
-        self.k_cache, self.v_cache = init_kv_cache(cfg, n_slots, self.max_seq_len)
-        self.rng = jax.random.PRNGKey(seed)
         self.slots = [_Slot() for _ in range(n_slots)]
         self._pending: "queue.Queue[GenerationRequest]" = queue.Queue()
         self._wake = threading.Event()
@@ -132,12 +167,26 @@ class LLMEngine:
         self._jnp = jnp
         self._obs = MetricsHook(self.metrics)
 
+        # in-flight dispatches awaiting host sync, processed FIFO:
+        #   ("decode", out_tokens [B, M] future, [(slot_idx, request)], M)
+        #   ("prefill", first_tokens [K] future, [(slot_idx, request)])
+        self._inflight: "collections.deque" = collections.deque()
+
+        self._init_device_state()
+
         # rolling throughput window
         self._tok_window: List[tuple] = []
 
-        # host-side mirrors of per-slot device state
-        self._cur_tokens = [0] * n_slots
-        self._temps = [0.0] * n_slots
+    def _init_device_state(self) -> None:
+        jnp = self._jnp
+        import jax
+
+        B = self.n_slots
+        self.k_cache, self.v_cache = init_kv_cache(self.cfg, B, self.max_seq_len)
+        self._tokens = jnp.zeros((B,), dtype=jnp.int32)
+        self._positions = jnp.zeros((B,), dtype=jnp.int32)
+        self._temps = jnp.zeros((B,), dtype=jnp.float32)
+        self.rng = jax.random.PRNGKey(next(self._reset_counter))
 
     # -- public API -----------------------------------------------------------
     def submit(self, prompt_tokens: Sequence[int], max_new_tokens: int = 128,
@@ -175,207 +224,265 @@ class LLMEngine:
         self._stop.set()
         self._wake.set()
         if self._thread is not None:
-            self._thread.join(timeout=10)
+            self._thread.join(timeout=30)
             self._thread = None
         self._drain_pending(RuntimeError("engine stopped"))
 
     def warmup(self) -> None:
-        """Pre-compile every prefill bucket + the decode step at boot."""
-        import numpy as np
-
+        """Pre-compile the decode block and every single-admission prefill
+        bucket at boot; batched-K prefill variants compile on first use."""
         for bucket in self.prefill_buckets:
-            tokens = np.zeros((1, bucket), dtype=np.int32)
-            self._prefill_program(bucket)  # compile only
+            self._prefill_program(bucket, 1)
             if self.logger is not None:
                 self.logger.debugf("warmed prefill bucket %d", bucket)
-            del tokens
         self._decode_program()
 
     # -- compiled programs ----------------------------------------------------
-    def _prefill_fn(self, bucket: int):
+    def _prefill_fn(self, bucket: int, K: int):
         cfg = self.cfg
         jnp = self._jnp
-        import jax
+        top_k = self.top_k
 
-        def prefill(params, k_cache, v_cache, tokens, slot, length):
-            """tokens: [1, bucket]; writes slot row of the big cache.
-            Returns (k_cache, v_cache, last_logits [V])."""
+        def prefill(params, k_cache, v_cache, ptokens, slots, lengths,
+                    tokens, positions, temps, new_temps, rng):
+            """Fused K-way admission: prefill K prompts ([K, bucket]) into K
+            slot rows, sample their first tokens on device, and splice the
+            per-slot loop state (tokens/positions/temps) in one program.
+            Returns (k_cache, v_cache, tokens, positions, temps, rng,
+            first_tokens [K])."""
             L, _, S, Hkv, dh = k_cache.shape
-            tmp_k = jnp.zeros((L, 1, bucket, Hkv, dh), dtype=k_cache.dtype)
+            tmp_k = jnp.zeros((L, K, bucket, Hkv, dh), dtype=k_cache.dtype)
             tmp_v = jnp.zeros_like(tmp_k)
-            positions = jnp.arange(bucket, dtype=jnp.int32)[None, :]
-            logits, tmp_k, tmp_v = llama_forward(params, cfg, tokens, positions,
+            pos_grid = jnp.broadcast_to(
+                jnp.arange(bucket, dtype=jnp.int32)[None, :], (K, bucket))
+            logits, tmp_k, tmp_v = llama_forward(params, cfg, ptokens, pos_grid,
                                                  tmp_k, tmp_v)
-            k_cache = jax.lax.dynamic_update_slice(k_cache, tmp_k, (0, slot, 0, 0, 0))
-            v_cache = jax.lax.dynamic_update_slice(v_cache, tmp_v, (0, slot, 0, 0, 0))
-            last = logits[0, length - 1, :]
-            return k_cache, v_cache, last
+            row = slots[:, None]                       # [K, 1]
+            col = jnp.arange(bucket, dtype=jnp.int32)[None, :]  # [1, bucket]
+            k_cache = k_cache.at[:, row, col].set(tmp_k)
+            v_cache = v_cache.at[:, row, col].set(tmp_v)
+            last = logits[jnp.arange(K), lengths - 1]  # [K, V]
+            first, rng = sample_tokens(last, rng, new_temps, top_k=top_k)
+            tokens = tokens.at[slots].set(first)
+            positions = positions.at[slots].set(lengths)
+            temps = temps.at[slots].set(new_temps)
+            return k_cache, v_cache, tokens, positions, temps, rng, first
 
         return prefill
 
-    def _prefill_program(self, bucket: int):
-        import numpy as np
-
-        tokens = self._jnp.zeros((1, bucket), dtype=self._jnp.int32)
+    def _prefill_program(self, bucket: int, K: int):
+        jnp = self._jnp
+        args = (self.params, self.k_cache, self.v_cache,
+                jnp.zeros((K, bucket), dtype=jnp.int32),
+                jnp.zeros((K,), dtype=jnp.int32),
+                jnp.ones((K,), dtype=jnp.int32),
+                self._tokens, self._positions, self._temps,
+                jnp.zeros((K,), dtype=jnp.float32), self.rng)
         return self.executor.compile(
-            f"llama-prefill-{bucket}", self._prefill_fn(bucket),
-            (self.params, self.k_cache, self.v_cache, tokens,
-             np.int32(0), np.int32(1)),
-            donate_argnums=(1, 2))
+            f"llama-prefill-{bucket}x{K}", self._prefill_fn(bucket, K),
+            args, donate_argnums=(1, 2, 6, 7, 8))
 
-    def _decode_fn(self):
+    def _decode_fn(self, block: int):
         cfg = self.cfg
         top_k = self.top_k
+        import jax
 
         def decode(params, k_cache, v_cache, tokens, positions, temps, rng):
-            logits, k_cache, v_cache = llama_decode_step(
-                params, cfg, tokens, positions, k_cache, v_cache)
-            next_tokens, rng = sample_tokens(logits, rng, temps, top_k=top_k)
-            return k_cache, v_cache, next_tokens, rng
+            """`block` lock-step decode steps under scan; loop state chains on
+            device. Returns (k_cache, v_cache, tokens, positions, rng,
+            out_tokens [B, block])."""
+
+            def step(carry, _):
+                k, v, tok, pos, rng = carry
+                logits, k, v = llama_decode_step(params, cfg, tok, pos, k, v)
+                nxt, rng = sample_tokens(logits, rng, temps, top_k=top_k)
+                return (k, v, nxt, pos + 1, rng), nxt
+
+            (k_cache, v_cache, tok, pos, rng), out = jax.lax.scan(
+                step, (k_cache, v_cache, tokens, positions, rng), None,
+                length=block)
+            return k_cache, v_cache, tok, pos, rng, out.T  # [B, block]
 
         return decode
 
-    def _decode_program(self):
+    def _decode_program(self, block: Optional[int] = None):
+        block = block or self.decode_block_size
         jnp = self._jnp
         B = self.n_slots
         args = (self.params, self.k_cache, self.v_cache,
-                jnp.zeros((B,), dtype=jnp.int32), jnp.zeros((B,), dtype=jnp.int32),
-                jnp.zeros((B,), dtype=jnp.float32), self.rng)
-        return self.executor.compile("llama-decode", self._decode_fn(), args,
+                self._tokens, self._positions, self._temps, self.rng)
+        del jnp
+        return self.executor.compile(f"llama-decode-x{block}",
+                                     self._decode_fn(block), args,
                                      donate_argnums=(1, 2))
 
     # -- engine loop ----------------------------------------------------------
     def _loop(self) -> None:
         while not self._stop.is_set():
-            admitted = self._admit()
-            any_active = any(slot.active for slot in self.slots)
-            if not any_active:
-                self._wake.wait(timeout=0.1)
-                self._wake.clear()
-                continue
             try:
-                self._decode_once()
+                self._admit()
+                any_active = any(slot.active for slot in self.slots)
+                while any_active and len(self._inflight) < self.pipeline_depth:
+                    self._dispatch_decode()
+                if self._inflight:
+                    self._sync_oldest()
+                else:
+                    self._wake.wait(timeout=0.05)
+                    self._wake.clear()
             except Exception as exc:  # noqa: BLE001 - fail active requests, keep serving
                 if self.logger is not None:
-                    self.logger.errorf("decode step failed: %s", exc)
+                    self.logger.errorf("engine step failed: %s", exc)
                 self._reset_device_state(exc)
-            del admitted
-
-    def _admit(self) -> int:
-        """Move pending requests into free slots (runs a prefill per admit)."""
-        admitted = 0
-        for slot_idx, slot in enumerate(self.slots):
-            if slot.active:
-                continue
-            request = None
-            while request is None:
-                try:
-                    request = self._pending.get_nowait()
-                except queue.Empty:
-                    break
-                if request.cancelled.is_set():
-                    request.out_queue.put(None)
-                    request = None
-            if request is None:
-                break
+        # graceful shutdown: finish what was already dispatched
+        while self._inflight:
             try:
-                self._prefill_into(slot_idx, slot, request)
-                admitted += 1
-            except Exception as exc:  # noqa: BLE001 - bad request must not kill the loop
-                request.error = exc
-                request.out_queue.put(None)
-                slot.request = None
-                # the prefill program donates the caches; a failure after
-                # dispatch may have consumed them, so rebuild device state
-                # (fails any other active request — their KV is gone too)
+                self._sync_oldest()
+            except Exception as exc:  # noqa: BLE001
                 self._reset_device_state(exc)
+
+    def _admit(self) -> None:
+        """Fuse pending requests into batched prefill dispatches, one per
+        (bucket, K) group."""
+        free = [i for i, slot in enumerate(self.slots) if not slot.active]
+        if not free:
+            return
+        taken: List[GenerationRequest] = []
+        while len(taken) < len(free):
+            try:
+                request = self._pending.get_nowait()
+            except queue.Empty:
+                break
+            if request.cancelled.is_set():
+                request.out_queue.put(None)
+                continue
+            taken.append(request)
+        if not taken:
+            return
+
+        # group by prompt bucket, then split counts into powers of two
+        by_bucket: Dict[int, List[GenerationRequest]] = {}
+        for request in taken:
+            bucket = next_bucket(len(request.prompt_tokens), self.prefill_buckets)
+            by_bucket.setdefault(bucket, []).append(request)
+
+        free_iter = iter(free)
+        dispatched: Set[int] = set()
+        try:
+            for bucket, group in by_bucket.items():
+                offset = 0
+                for K in _pow2_split(len(group), self.n_slots):
+                    batch = group[offset:offset + K]
+                    offset += K
+                    slots_idx = [next(free_iter) for _ in batch]
+                    self._dispatch_prefill(bucket, slots_idx, batch)
+                    dispatched.update(r.id for r in batch)
+        except Exception as exc:
+            # fail requests that never reached a dispatch (dispatched ones
+            # hold slots and are failed by the caller's device-state reset)
+            for request in taken:
+                if request.id not in dispatched:
+                    request.error = exc
+                    request.out_queue.put(None)
+            raise
+
         self._obs.gauge("app_tpu_queue_depth", self._pending.qsize())
         self._obs.gauge("app_tpu_active_slots",
-                            sum(1 for s in self.slots if s.active))
-        return admitted
+                        sum(1 for s in self.slots if s.active))
 
-    def _prefill_into(self, slot_idx: int, slot: _Slot, request: GenerationRequest) -> None:
+    def _dispatch_prefill(self, bucket: int,
+                          slots_idx: List[int],
+                          batch: List[GenerationRequest]) -> None:
         import numpy as np
 
-        length = len(request.prompt_tokens)
-        bucket = next_bucket(length, self.prefill_buckets)
-        tokens = np.zeros((1, bucket), dtype=np.int32)
-        tokens[0, :length] = request.prompt_tokens
-        program = self._prefill_program(bucket)
-        self.k_cache, self.v_cache, last_logits = program(
-            self.params, self.k_cache, self.v_cache, self._jnp.asarray(tokens),
-            np.int32(slot_idx), np.int32(length))
-
-        # sample the first token from the prefill logits on host (single row)
-        first = self._sample_host(last_logits, request.temperature)
-        now = time.time()
-        request.first_token_at = now
-        self._obs.hist("app_tpu_ttft_seconds", now - request.enqueued_at)
-        self._emit(request, first)
-
-        slot.request = request
-        # length counts tokens whose KV is in the cache (the prompt); the
-        # just-sampled first token is written at position `length` by the
-        # next decode step
-        slot.length = length
-        slot.remaining = request.max_new_tokens - 1
-        self._cur_tokens[slot_idx] = first
-        self._temps[slot_idx] = request.temperature
-        if first in request.stop_tokens or slot.remaining <= 0:
-            self._finish_slot(slot)
-
-    def _sample_host(self, logits_row, temperature: float) -> int:
-        import numpy as np
-
-        # same sampling program as decode steps so top_k applies to the
-        # first token too
-        tokens, self.rng = sample_tokens(
-            logits_row[None, :], self.rng,
-            self._jnp.asarray([temperature], dtype=self._jnp.float32),
-            top_k=self.top_k)
-        return int(np.asarray(tokens[0]))
-
-    def _decode_once(self) -> None:
-        import numpy as np
-
+        K = len(batch)
         jnp = self._jnp
-        B = self.n_slots
-        tokens = np.zeros((B,), dtype=np.int32)
-        positions = np.zeros((B,), dtype=np.int32)
-        temps = np.zeros((B,), dtype=np.float32)
-        for i, slot in enumerate(self.slots):
-            if slot.active:
-                tokens[i] = self._cur_tokens[i]
-                positions[i] = slot.length  # write the new token's kv here
-                temps[i] = self._temps[i]
+        ptokens = np.zeros((K, bucket), dtype=np.int32)
+        lengths = np.zeros((K,), dtype=np.int32)
+        new_temps = np.zeros((K,), dtype=np.float32)
+        for row, request in enumerate(batch):
+            n = len(request.prompt_tokens)
+            ptokens[row, :n] = request.prompt_tokens
+            lengths[row] = n
+            new_temps[row] = request.temperature
 
+        program = self._prefill_program(bucket, K)
+        (self.k_cache, self.v_cache, self._tokens, self._positions,
+         self._temps, self.rng, first) = program(
+            self.params, self.k_cache, self.v_cache,
+            jnp.asarray(ptokens), jnp.asarray(np.asarray(slots_idx, dtype=np.int32)),
+            jnp.asarray(lengths), self._tokens, self._positions, self._temps,
+            jnp.asarray(new_temps), self.rng)
+
+        admitted = []
+        for row, request in enumerate(batch):
+            slot = self.slots[slots_idx[row]]
+            slot.request = request
+            # length counts tokens whose KV is in the cache (the prompt); the
+            # first sampled token is written at `length` by the next decode
+            slot.length = len(request.prompt_tokens)
+            slot.remaining = request.max_new_tokens - 1
+            admitted.append((slots_idx[row], request))
+        self._inflight.append(("prefill", first, admitted))
+
+    def _dispatch_decode(self) -> None:
         program = self._decode_program()
+        snapshot = [(i, slot.request) for i, slot in enumerate(self.slots)
+                    if slot.active]
         start = time.time()
-        self.k_cache, self.v_cache, next_tokens, self.rng = program(
-            self.params, self.k_cache, self.v_cache, jnp.asarray(tokens),
-            jnp.asarray(positions), jnp.asarray(temps), self.rng)
-        next_host = np.asarray(next_tokens)  # device sync point
-        step_s = time.time() - start
-        self._obs.hist("app_tpu_execute_seconds", step_s)
+        (self.k_cache, self.v_cache, self._tokens, self._positions,
+         self.rng, out_tokens) = program(
+            self.params, self.k_cache, self.v_cache,
+            self._tokens, self._positions, self._temps, self.rng)
+        self._inflight.append(("decode", out_tokens, snapshot,
+                               self.decode_block_size, start))
+
+    def _sync_oldest(self) -> None:
+        import numpy as np
+
+        entry = self._inflight.popleft()
+        if entry[0] == "prefill":
+            _, first, admitted = entry
+            first_host = np.asarray(first)  # blocks until the device got there
+            now = time.time()
+            for row, (slot_idx, request) in enumerate(admitted):
+                slot = self.slots[slot_idx]
+                if slot.request is not request:  # cancelled between dispatch+sync
+                    continue
+                request.first_token_at = now
+                self._obs.hist("app_tpu_ttft_seconds", now - request.enqueued_at)
+                token = int(first_host[row])
+                self._emit(request, token)
+                if (token in request.stop_tokens or slot.remaining <= 0
+                        or request.cancelled.is_set()):
+                    self._finish_slot(slot)
+            return
+
+        _, out_tokens, snapshot, block, started = entry
+        tokens_host = np.asarray(out_tokens)  # [B, block]; device sync point
+        step_s = (time.time() - started) / block
+        self._obs.hist("app_tpu_execute_seconds", time.time() - started)
 
         n_active = 0
-        for i, slot in enumerate(self.slots):
-            if not slot.active:
+        emitted = 0
+        for slot_idx, request in snapshot:
+            slot = self.slots[slot_idx]
+            if slot.request is not request:  # freed/replaced mid-flight: junk
                 continue
             n_active += 1
-            token = int(next_host[i])
-            request = slot.request
-            slot.length += 1
-            slot.remaining -= 1
-            self._cur_tokens[i] = token
-            self._emit(request, token)
-            self._obs.hist("app_tpu_tpot_seconds", step_s)
-            if (token in request.stop_tokens or slot.remaining <= 0
-                    or request.cancelled.is_set()
-                    or slot.length >= self.max_seq_len - 1):
-                self._finish_slot(slot)
+            for t in range(block):
+                token = int(tokens_host[slot_idx, t])
+                slot.length += 1
+                slot.remaining -= 1
+                self._emit(request, token)
+                emitted += 1
+                self._obs.hist("app_tpu_tpot_seconds", step_s)
+                if (token in request.stop_tokens or slot.remaining <= 0
+                        or request.cancelled.is_set()
+                        or slot.length >= self.max_seq_len - 1):
+                    self._finish_slot(slot)
+                    break
         self._obs.hist("app_tpu_batch_size", n_active)
-        self._track_throughput(n_active)
+        self._track_throughput(emitted)
 
     def _emit(self, request: GenerationRequest, token: int) -> None:
         request.generated += 1
@@ -394,15 +501,15 @@ class LLMEngine:
                             sum(1 for s in self.slots if s.active))
 
     def _reset_device_state(self, exc: BaseException) -> None:
-        """Rebuild the KV cache after a failed donated-cache program
+        """Rebuild all device state after a failed donated-cache program
         (donation means the old buffers may be deleted on TPU/GPU) and fail
         every active request, whose cached context no longer exists."""
+        self._inflight.clear()
         for slot in self.slots:
             if slot.active:
                 slot.request.error = exc
                 self._finish_slot(slot)
-        self.k_cache, self.v_cache = init_kv_cache(self.cfg, self.n_slots,
-                                                   self.max_seq_len)
+        self._init_device_state()
 
     def _drain_pending(self, exc: BaseException) -> None:
         while True:
@@ -424,4 +531,3 @@ class LLMEngine:
             total = sum(t for _, t in self._tok_window)
             if span > 0:
                 self._obs.gauge("app_tpu_tokens_per_second", total / span)
-

@@ -1,0 +1,134 @@
+"""File/zip util + document store datasource (reference pkg/gofr/file/,
+pkg/gofr/datasource/mongo/)."""
+
+import os
+
+import pytest
+
+from gofr_tpu.datasource import STATUS_DOWN, STATUS_UP
+from gofr_tpu.datasource.docstore import DocumentStore, New, _matches
+from gofr_tpu.file import (MAX_DECOMPRESSED_BYTES, Zip, ZipBombError, new_zip,
+                           zip_files)
+from gofr_tpu.logging import MockLogger
+
+
+# -- zip util -----------------------------------------------------------------
+def test_zip_roundtrip(tmp_path):
+    data = zip_files({"a.txt": b"hello", "dir/b.bin": b"\x00\x01\x02"})
+    archive = new_zip(data)
+    assert len(archive) == 2
+    assert "a.txt" in archive
+    assert archive["a.txt"].bytes() == b"hello"
+    assert archive["dir/b.bin"].size == 3
+
+    archive.create_local_copies(str(tmp_path))
+    assert (tmp_path / "a.txt").read_bytes() == b"hello"
+    assert (tmp_path / "dir" / "b.bin").read_bytes() == b"\x00\x01\x02"
+
+
+def test_zip_bomb_guard():
+    # 1 MB of zeros compresses tiny but decompresses over a 100 KB limit
+    data = zip_files({"big.bin": b"\x00" * (1024 * 1024)})
+    with pytest.raises(ZipBombError):
+        Zip.from_bytes(data, max_bytes=100 * 1024)
+    # default guard admits it
+    assert len(Zip.from_bytes(data)) == 1
+    assert MAX_DECOMPRESSED_BYTES == 100 * 1024 * 1024
+
+
+def test_zip_path_traversal_rejected(tmp_path):
+    archive = Zip({"../evil.txt": __import__("gofr_tpu.file", fromlist=["File"]).File(
+        "../evil.txt", b"x")})
+    with pytest.raises(ValueError):
+        archive.create_local_copies(str(tmp_path / "sub"))
+
+
+def test_zip_from_path(tmp_path):
+    p = tmp_path / "a.zip"
+    p.write_bytes(zip_files({"x": b"y"}))
+    assert Zip.from_path(str(p))["x"].content == b"y"
+
+
+# -- document store -----------------------------------------------------------
+@pytest.fixture
+def store():
+    s = New()
+    s.use_logger(MockLogger())
+    s.connect()
+    return s
+
+
+def test_docstore_requires_connect():
+    s = DocumentStore()
+    with pytest.raises(RuntimeError):
+        s.insert_one("c", {"a": 1})
+    assert s.health_check().status == STATUS_DOWN
+
+
+def test_docstore_crud(store):
+    id1 = store.insert_one("users", {"name": "ada", "age": 36})
+    ids = store.insert_many("users", [{"name": "bob", "age": 20},
+                                      {"name": "cy", "age": 50}])
+    assert id1 and len(ids) == 2
+
+    assert store.count_documents("users") == 3
+    assert store.find_one("users", {"name": "ada"})["age"] == 36
+    assert store.find_one("users", {"name": "nobody"}) is None
+
+    older = store.find("users", {"age": {"$gte": 36}})
+    assert sorted(d["name"] for d in older) == ["ada", "cy"]
+    assert [d["name"] for d in store.find("users", {"age": {"$lt": 30}})] == ["bob"]
+    assert store.count_documents("users", {"name": {"$in": ["ada", "bob"]}}) == 2
+
+    assert store.update_one("users", {"name": "bob"}, {"$set": {"age": 21}}) == 1
+    assert store.find_one("users", {"name": "bob"})["age"] == 21
+    assert store.update_many("users", {"age": {"$gt": 30}}, {"flag": True}) == 2
+
+    assert store.delete_one("users", {"name": "ada"}) == 1
+    assert store.delete_many("users", {"age": {"$ne": None}}) == 2
+    assert store.count_documents("users") == 0
+
+
+def test_docstore_collections_and_health(store):
+    store.create_collection("empty")
+    store.insert_one("full", {"x": 1})
+    h = store.health_check()
+    assert h.status == STATUS_UP
+    assert h.details["collections"] == 2
+    store.drop_collection("full")
+    assert store.count_documents("full") == 0
+
+
+def test_docstore_persistence(tmp_path):
+    path = str(tmp_path / "docs.json")
+    s1 = New({"path": path})
+    s1.use_logger(MockLogger())
+    s1.connect()
+    s1.insert_one("kv", {"k": "v"})
+    s1.close()
+    assert os.path.exists(path)
+
+    s2 = New({"path": path})
+    s2.connect()
+    assert s2.find_one("kv", {"k": "v"}) is not None
+
+
+def test_docstore_unsupported_operator(store):
+    store.insert_one("c", {"a": 1})
+    with pytest.raises(ValueError):
+        store.find("c", {"a": {"$regex": "x"}})
+    assert not _matches({"a": 1}, {"b": 1})
+
+
+def test_docstore_app_wiring():
+    from gofr_tpu.container import new_mock_container
+
+    c = new_mock_container()
+    s = New()
+    s.use_logger(c.logger)
+    s.use_metrics(c.metrics_manager)
+    s.connect()
+    c.docstore = s
+    s.insert_one("t", {"a": 1})  # exercises the metrics histogram path
+    health = c.health()
+    assert health["details"]["docstore"]["status"] == STATUS_UP

@@ -251,3 +251,38 @@ def test_engine_rejects_bad_prompts(engine):
         engine.submit([])
     with pytest.raises(ValueError):
         engine.submit(list(range(100)))  # exceeds largest prefill bucket (16)
+
+
+def test_engine_pipelined_matches_synchronous():
+    """block=4/depth=3 pipelined engine emits the same greedy tokens as the
+    fully synchronous block=1/depth=1 configuration, including under fused
+    multi-request admission."""
+    from gofr_tpu.models.llama import LlamaConfig, llama_init
+    from gofr_tpu.tpu.engine import LLMEngine
+
+    cfg = LlamaConfig.debug()
+    params = llama_init(cfg, seed=0)
+    prompts = [[1, 2, 3], [7, 8], [4, 5, 6, 9], [2, 2, 2], [11, 12]]
+
+    def run(block, depth):
+        eng = LLMEngine(params, cfg, n_slots=4, max_seq_len=64,
+                        prefill_buckets=(8,), decode_block_size=block,
+                        pipeline_depth=depth)
+        eng.start()
+        try:
+            reqs = [eng.submit(p, max_new_tokens=7, temperature=0.0)
+                    for p in prompts]
+            return [r.result(timeout_s=120) for r in reqs]
+        finally:
+            eng.stop()
+
+    assert run(1, 1) == run(4, 3)
+
+
+def test_engine_pow2_split():
+    from gofr_tpu.tpu.engine import _pow2_split
+
+    assert _pow2_split(11, 64) == [8, 2, 1]
+    assert _pow2_split(64, 64) == [64]
+    assert _pow2_split(5, 4) == [4, 1]
+    assert _pow2_split(1, 8) == [1]
