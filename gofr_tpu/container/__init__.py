@@ -79,9 +79,24 @@ class Container:
         if backend in ("inproc", "memory"):
             from ..pubsub.inproc import InProcBroker
             c.pubsub = InProcBroker(config, c.logger, c.metrics_manager)
+        elif backend == "file":
+            from ..pubsub.filebroker import FileBroker
+            c.pubsub = FileBroker(config, c.logger, c.metrics_manager)
+        elif backend in ("kafka", "mqtt", "google"):
+            # external drivers resolve lazily; boot survives a missing one
+            # the same way a misconfigured SQL datasource stays nil
+            # (reference sql/sql.go:33-36)
+            try:
+                from ..pubsub import external
+                cls = {"kafka": external.KafkaAdapter,
+                       "mqtt": external.MQTTAdapter,
+                       "google": external.GooglePubSubAdapter}[backend]
+                c.pubsub = cls(config, c.logger, c.metrics_manager)
+            except Exception as exc:  # noqa: BLE001
+                c.logger.errorf("could not initialise %s pub/sub: %s", backend, exc)
         elif backend:
-            c.logger.errorf("unsupported PUBSUB_BACKEND %r (bundled: inproc); pub/sub disabled",
-                            backend)
+            c.logger.errorf("unsupported PUBSUB_BACKEND %r (bundled: inproc, file; "
+                            "external: kafka, mqtt, google); pub/sub disabled", backend)
 
         if config.get_bool("TPU_ENABLED", False) or config.get_or_default("MODEL_NAME", ""):
             try:
