@@ -52,6 +52,11 @@ class App:
             self.config = config
             self.container = Container.create(config)
 
+        from . import native
+
+        native.available()  # build/load the C++ runtime helpers at boot so
+        # no request-path call ever pays the compile
+
         self.logger = self.container.logger
         self.router = Router()
         self.request_timeout_s = self.config.get_float("REQUEST_TIMEOUT", DEFAULT_REQUEST_TIMEOUT_S)

@@ -50,19 +50,19 @@ class StreamingDecoder:
         self._buf = bytearray()
 
     def push(self, token: int) -> str:
+        from .. import native
+
         if not (0 <= token < 256):
             return ""
         self._buf.append(token)
-        try:
-            text = self._buf.decode("utf-8")
-            self._buf.clear()
-            return text
-        except UnicodeDecodeError:
-            if len(self._buf) >= 4:  # not a valid prefix; flush replacement
-                text = self._buf.decode("utf-8", errors="replace")
-                self._buf.clear()
-                return text
+        # boundary scan in C (pure-python mirror when the lib is absent):
+        # emit every complete codepoint, keep the valid-but-incomplete tail
+        n = native.utf8_complete_prefix(bytes(self._buf))
+        if n == 0:
             return ""
+        text = bytes(self._buf[:n]).decode("utf-8", errors="replace")
+        del self._buf[:n]
+        return text
 
     def flush(self) -> str:
         text = self._buf.decode("utf-8", errors="replace")
@@ -86,6 +86,28 @@ class BPETokenizer:
         self.bos_id = vocab.get(bos_token)
         self.eos_id = vocab.get(eos_token)
         self.vocab_size = max(vocab.values()) + 1 if vocab else 0
+        self._native = self._build_native(merges)
+
+    def _build_native(self, merges: List[str]):
+        """Hot-path merge loop in C++ when every merge is id-representable
+        (left, right, AND merged piece all in vocab — true for real model
+        vocabs); otherwise stay on the python string-level path."""
+        from .. import native
+
+        if not merges or not native.available():
+            return None
+        triples = []
+        for m in merges:
+            left, _, right = m.partition(" ")
+            lid, rid = self.vocab.get(left), self.vocab.get(right)
+            mid = self.vocab.get(left + right)
+            if lid is None or rid is None or mid is None:
+                return None
+            triples.append((lid, rid, mid))
+        try:
+            return native.BPECore(triples)
+        except RuntimeError:
+            return None
 
     @classmethod
     def from_file(cls, path: str, **kw) -> "BPETokenizer":
@@ -107,11 +129,17 @@ class BPETokenizer:
         ids: List[int] = []
         if bos and self.bos_id is not None:
             ids.append(self.bos_id)
-        for piece in self._bpe(list(text)):
-            if piece in self.vocab:
-                ids.append(self.vocab[piece])
-            else:
-                ids.extend(self.vocab.get(ch, 0) for ch in piece)
+        char_ids = ([self.vocab[ch] for ch in text]
+                    if self._native is not None and
+                    all(ch in self.vocab for ch in text) else None)
+        if char_ids is not None:
+            ids.extend(self._native.encode(char_ids))
+        else:
+            for piece in self._bpe(list(text)):
+                if piece in self.vocab:
+                    ids.append(self.vocab[piece])
+                else:
+                    ids.extend(self.vocab.get(ch, 0) for ch in piece)
         if eos and self.eos_id is not None:
             ids.append(self.eos_id)
         return ids

@@ -1,0 +1,179 @@
+"""ctypes loader for the native runtime helpers (libgofr_native.so).
+
+The shared library is built from gofr_native.cc on first import when a C++
+toolchain is present (auto-build, cached next to the source); every consumer
+degrades to its pure-Python path when `available()` is False, so the
+framework never hard-requires the toolchain — the same graceful-nil posture
+datasources take on misconfiguration (reference sql/sql.go:33-36).
+
+API:
+  available() -> bool
+  BPECore(merge_triples)   — id-level greedy BPE merges (hot encode loop)
+  pad_batch(rows, max_len, pad_id) -> np.ndarray[int32]
+  utf8_complete_prefix(buf) -> int
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_DIR, "libgofr_native.so")
+_SRC = os.path.join(_DIR, "gofr_native.cc")
+
+_lib = None
+_load_lock = threading.Lock()
+_load_attempted = False
+
+_i32p = ctypes.POINTER(ctypes.c_int32)
+_i64p = ctypes.POINTER(ctypes.c_int64)
+
+
+def _build() -> bool:
+    cxx = os.environ.get("CXX", "g++")
+    try:
+        result = subprocess.run(
+            [cxx, "-O3", "-std=c++17", "-fPIC", "-shared", "-o", _SO, _SRC],
+            capture_output=True, timeout=120)
+        return result.returncode == 0 and os.path.exists(_SO)
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+
+
+def _bind(lib) -> None:
+    lib.gn_version.restype = ctypes.c_char_p
+    lib.gn_bpe_new.restype = ctypes.c_void_p
+    lib.gn_bpe_new.argtypes = [ctypes.c_int32, _i32p, _i32p, _i32p]
+    lib.gn_bpe_free.argtypes = [ctypes.c_void_p]
+    lib.gn_bpe_encode.restype = ctypes.c_int32
+    lib.gn_bpe_encode.argtypes = [ctypes.c_void_p, _i32p, ctypes.c_int32, _i32p]
+    lib.gn_pad_batch.restype = ctypes.c_int32
+    lib.gn_pad_batch.argtypes = [_i32p, _i64p, ctypes.c_int32, ctypes.c_int32,
+                                 ctypes.c_int32, _i32p]
+    lib.gn_utf8_complete_prefix.restype = ctypes.c_int32
+    lib.gn_utf8_complete_prefix.argtypes = [ctypes.POINTER(ctypes.c_uint8),
+                                            ctypes.c_int32]
+
+
+def _load():
+    global _lib, _load_attempted
+    with _load_lock:
+        if _lib is not None or _load_attempted:
+            return _lib
+        _load_attempted = True
+        if not os.path.exists(_SO) or (os.path.exists(_SRC) and
+                                       os.path.getmtime(_SRC) > os.path.getmtime(_SO)):
+            if not _build():
+                return None
+        try:
+            lib = ctypes.CDLL(_SO)
+            _bind(lib)
+            _lib = lib
+        except OSError:
+            _lib = None
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def version() -> str:
+    lib = _load()
+    return lib.gn_version().decode() if lib else "unavailable"
+
+
+class BPECore:
+    """Native greedy BPE over token ids.
+
+    merge_triples: ordered [(left_id, right_id, merged_id)] — index is rank.
+    """
+
+    def __init__(self, merge_triples: Sequence[Tuple[int, int, int]]):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("gofr_native unavailable (no C++ toolchain?)")
+        self._lib = lib
+        arr = np.asarray(merge_triples, dtype=np.int32).reshape(-1, 3)
+        left = np.ascontiguousarray(arr[:, 0])
+        right = np.ascontiguousarray(arr[:, 1])
+        merged = np.ascontiguousarray(arr[:, 2])
+        self._handle = lib.gn_bpe_new(
+            len(arr), left.ctypes.data_as(_i32p), right.ctypes.data_as(_i32p),
+            merged.ctypes.data_as(_i32p))
+
+    def encode(self, ids: Sequence[int]) -> List[int]:
+        src = np.asarray(ids, dtype=np.int32)
+        if src.size == 0:
+            return []
+        src = np.ascontiguousarray(src)
+        out = np.empty(src.size, dtype=np.int32)
+        n = self._lib.gn_bpe_encode(self._handle, src.ctypes.data_as(_i32p),
+                                    src.size, out.ctypes.data_as(_i32p))
+        return out[:n].tolist()
+
+    def __del__(self):
+        handle = getattr(self, "_handle", None)
+        if handle:
+            self._lib.gn_bpe_free(handle)
+            self._handle = None
+
+
+def pad_batch(rows: Sequence[Sequence[int]], max_len: int,
+              pad_id: int = 0) -> Optional[np.ndarray]:
+    """Pack variable-length token rows into a padded [n, max_len] int32 matrix.
+
+    Overlong rows keep their tail. Returns None when the library is missing
+    (callers fall back to numpy loops).
+    """
+    lib = _load()
+    if lib is None:
+        return None
+    lengths = np.asarray([len(r) for r in rows], dtype=np.int64)
+    flat = (np.concatenate([np.asarray(r, dtype=np.int32) for r in rows])
+            if len(rows) and lengths.sum() else np.empty(0, dtype=np.int32))
+    flat = np.ascontiguousarray(flat)
+    out = np.empty((len(rows), max_len), dtype=np.int32)
+    rc = lib.gn_pad_batch(flat.ctypes.data_as(_i32p),
+                          lengths.ctypes.data_as(_i64p), len(rows), max_len,
+                          pad_id, out.ctypes.data_as(_i32p))
+    if rc != 0:
+        raise ValueError("gn_pad_batch failed (negative length or max_len)")
+    return out
+
+
+def utf8_complete_prefix(buf: bytes) -> int:
+    """Bytes of `buf` that form whole UTF-8 codepoints (SSE chunk boundary)."""
+    lib = _load()
+    if lib is None:
+        # pure-Python mirror of the C algorithm: back up over at most three
+        # continuation bytes; an incomplete-but-valid tail sequence is cut,
+        # anything invalid counts as complete (replacement char on decode)
+        if not buf:
+            return 0
+        i = len(buf) - 1
+        back = 0
+        while i > 0 and (buf[i] & 0xC0) == 0x80 and back < 3:
+            i -= 1
+            back += 1
+        lead = buf[i]
+        if (lead & 0x80) == 0:
+            need = 1
+        elif (lead & 0xE0) == 0xC0:
+            need = 2
+        elif (lead & 0xF0) == 0xE0:
+            need = 3
+        elif (lead & 0xF8) == 0xF0:
+            need = 4
+        else:
+            return len(buf)
+        return len(buf) if i + need <= len(buf) else i
+    arr = (ctypes.c_uint8 * len(buf)).from_buffer_copy(buf) if buf else \
+        (ctypes.c_uint8 * 1)()
+    return lib.gn_utf8_complete_prefix(arr, len(buf))

@@ -1,0 +1,138 @@
+// gofr_native: C++ runtime helpers for the serving hot path, exposed over a
+// plain C ABI and loaded from Python via ctypes (no pybind11 in this image).
+//
+// The reference framework is pure Go with zero native code (SURVEY.md §2.5);
+// this build's runtime-around-the-compute-path is where native belongs:
+//  - BPE encode: the greedy merge loop runs per request before the model ever
+//    sees a token; pure-Python is O(n^2) interpreter-bound.
+//  - pad_batch: assembles the padded [rows, max_len] int32 matrix the
+//    dynamic-batching scheduler ships to the device.
+//  - utf8_complete_prefix: how many bytes of a buffer form whole codepoints —
+//    the SSE streaming decoder's boundary scan.
+//
+// Build: `make -C gofr_tpu/native` or the auto-build in native/__init__.py.
+
+#include <cstdint>
+#include <cstring>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct PairHash {
+  size_t operator()(const std::pair<int32_t, int32_t>& p) const {
+    return (static_cast<size_t>(static_cast<uint32_t>(p.first)) << 32) ^
+           static_cast<uint32_t>(p.second);
+  }
+};
+
+struct BPE {
+  // (left, right) -> (rank, merged id); lower rank merges first
+  std::unordered_map<std::pair<int32_t, int32_t>, std::pair<int32_t, int32_t>,
+                     PairHash>
+      merges;
+};
+
+}  // namespace
+
+extern "C" {
+
+const char* gn_version() { return "gofr_native 1.0"; }
+
+void* gn_bpe_new(int32_t n_merges, const int32_t* left, const int32_t* right,
+                 const int32_t* merged) {
+  BPE* bpe = new BPE();
+  bpe->merges.reserve(static_cast<size_t>(n_merges) * 2);
+  for (int32_t i = 0; i < n_merges; ++i) {
+    // last occurrence wins, matching the python dict-comprehension ranks
+    bpe->merges[std::make_pair(left[i], right[i])] = std::make_pair(i, merged[i]);
+  }
+  return bpe;
+}
+
+void gn_bpe_free(void* handle) { delete static_cast<BPE*>(handle); }
+
+// Greedy lowest-rank-first merging over a doubly-linked list of tokens.
+// Returns the output length written into `out` (capacity must be >= n).
+int32_t gn_bpe_encode(void* handle, const int32_t* ids, int32_t n,
+                      int32_t* out) {
+  const BPE* bpe = static_cast<const BPE*>(handle);
+  if (n <= 0) return 0;
+  std::vector<int32_t> tok(ids, ids + n);
+  std::vector<int32_t> prev(n), next(n);
+  for (int32_t i = 0; i < n; ++i) {
+    prev[i] = i - 1;
+    next[i] = (i + 1 < n) ? i + 1 : -1;
+  }
+  int32_t head = 0;
+  while (true) {
+    // scan live pairs for the lowest-rank merge
+    int32_t best_rank = INT32_MAX, best_i = -1, best_merged = 0;
+    for (int32_t i = head; i != -1 && next[i] != -1; i = next[i]) {
+      auto it = bpe->merges.find({tok[i], tok[next[i]]});
+      if (it != bpe->merges.end() && it->second.first < best_rank) {
+        best_rank = it->second.first;
+        best_i = i;
+        best_merged = it->second.second;
+      }
+    }
+    if (best_i == -1) break;
+    int32_t j = next[best_i];
+    tok[best_i] = best_merged;
+    next[best_i] = next[j];
+    if (next[j] != -1) prev[next[j]] = best_i;
+  }
+  int32_t n_out = 0;
+  for (int32_t i = head; i != -1; i = next[i]) out[n_out++] = tok[i];
+  return n_out;
+}
+
+// Pack `n_rows` variable-length rows (concatenated in `flat`, row i spanning
+// lengths[i] elements) into out[n_rows * max_len], right-padded with pad_id.
+// Rows longer than max_len keep their TAIL (decode context) — matching the
+// scheduler's truncation rule. Returns 0 on success.
+int32_t gn_pad_batch(const int32_t* flat, const int64_t* lengths,
+                     int32_t n_rows, int32_t max_len, int32_t pad_id,
+                     int32_t* out) {
+  if (n_rows < 0 || max_len <= 0) return -1;
+  const int32_t* src = flat;
+  for (int32_t r = 0; r < n_rows; ++r) {
+    int64_t len = lengths[r];
+    if (len < 0) return -1;
+    int32_t* row = out + static_cast<int64_t>(r) * max_len;
+    int64_t copy = len < max_len ? len : max_len;
+    const int32_t* start = src + (len - copy);  // tail when truncating
+    std::memcpy(row, start, copy * sizeof(int32_t));
+    for (int64_t c = copy; c < max_len; ++c) row[c] = pad_id;
+    src += len;
+  }
+  return 0;
+}
+
+// Length of the longest prefix of buf[0..len) that ends on a UTF-8 codepoint
+// boundary. Invalid lead bytes count as complete (replacement on decode).
+int32_t gn_utf8_complete_prefix(const uint8_t* buf, int32_t len) {
+  if (len <= 0) return 0;
+  int32_t i = len - 1;
+  // back up over at most 3 continuation bytes to the lead byte
+  int32_t back = 0;
+  while (i > 0 && (buf[i] & 0xC0) == 0x80 && back < 3) {
+    --i;
+    ++back;
+  }
+  uint8_t lead = buf[i];
+  int32_t need;
+  if ((lead & 0x80) == 0)
+    need = 1;
+  else if ((lead & 0xE0) == 0xC0)
+    need = 2;
+  else if ((lead & 0xF0) == 0xE0)
+    need = 3;
+  else if ((lead & 0xF8) == 0xF0)
+    need = 4;
+  else
+    return len;  // invalid lead (or stray continuation): treat as complete
+  return (i + need <= len) ? len : i;
+}
+
+}  // extern "C"

@@ -145,6 +145,10 @@ class LLMEngine:
         import jax
         import jax.numpy as jnp
 
+        from .. import native
+
+        native.available()  # build/load the C++ helpers at boot, not in the
+        # serving loop (first pad_batch call must never stall a decode step)
         self.params = params
         self.cfg = cfg
         self.n_slots = n_slots
@@ -206,6 +210,11 @@ class LLMEngine:
         request = GenerationRequest(prompt_tokens, max_new_tokens, temperature, stop_tokens)
         self._obs.counter("app_tpu_requests_total")
         self._pending.put(request)
+        if self._stop.is_set():
+            # stop() may have drained _pending between the check above and
+            # the put; drain again so this request cannot strand its client
+            self._drain_pending(RuntimeError("engine stopped"))
+            raise RuntimeError("engine is stopped")
         self._obs.gauge("app_tpu_queue_depth", self._pending.qsize())
         self._wake.set()
         return request
@@ -333,12 +342,18 @@ class LLMEngine:
                 if self.logger is not None:
                     self.logger.errorf("engine step failed: %s", exc)
                 self._reset_device_state(exc)
-        # graceful shutdown: finish what was already dispatched
+        # graceful shutdown: finish what was already dispatched, then fail
+        # requests still mid-generation so no client blocks on result()
         while self._inflight:
             try:
                 self._sync_oldest()
             except Exception as exc:  # noqa: BLE001
                 self._reset_device_state(exc)
+        stop_exc = RuntimeError("engine stopped")
+        for slot in self.slots:
+            if slot.active:
+                slot.request.error = stop_exc
+                self._finish_slot(slot)
 
     def _admit(self) -> None:
         """Fuse pending requests into batched prefill dispatches, one per
@@ -394,16 +409,19 @@ class LLMEngine:
                           batch: List[GenerationRequest]) -> None:
         import numpy as np
 
+        from .. import native
+
         K = len(batch)
         jnp = self._jnp
-        ptokens = np.zeros((K, bucket), dtype=np.int32)
-        lengths = np.zeros((K,), dtype=np.int32)
-        new_temps = np.zeros((K,), dtype=np.float32)
-        for row, request in enumerate(batch):
-            n = len(request.prompt_tokens)
-            ptokens[row, :n] = request.prompt_tokens
-            lengths[row] = n
-            new_temps[row] = request.temperature
+        ptokens = native.pad_batch([r.prompt_tokens for r in batch], bucket)
+        if ptokens is None:  # no C++ toolchain: numpy fallback
+            ptokens = np.zeros((K, bucket), dtype=np.int32)
+            for row, request in enumerate(batch):
+                ptokens[row, :len(request.prompt_tokens)] = request.prompt_tokens
+        lengths = np.asarray([len(r.prompt_tokens) for r in batch],
+                             dtype=np.int32)
+        new_temps = np.asarray([r.temperature for r in batch],
+                               dtype=np.float32)
 
         program = self._prefill_program(bucket, K)
         (self.k_cache, self.v_cache, self._tokens, self._positions,

@@ -1,0 +1,142 @@
+"""Native C++ runtime helpers (gofr_tpu/native): build, bind, parity, speed.
+
+The toolchain (g++) is baked into this image, so these tests exercise the
+real shared library; they skip rather than fail if a stripped environment
+lacks it, matching the library's own graceful-degrade contract.
+"""
+
+import numpy as np
+import pytest
+
+from gofr_tpu import native
+from gofr_tpu.models.tokenizer import BPETokenizer
+
+needs_native = pytest.mark.skipif(not native.available(),
+                                  reason="no C++ toolchain / build failed")
+
+
+@needs_native
+def test_version():
+    assert "gofr_native" in native.version()
+
+
+@needs_native
+def test_bpe_core_merges_in_rank_order():
+    # vocab: 0:'a' 1:'b' 2:'c' 3:'ab' 4:'abc'
+    core = native.BPECore([(0, 1, 3), (3, 2, 4)])
+    assert core.encode([0, 1, 2]) == [4]
+    assert core.encode([0, 1, 0, 1]) == [3, 3]
+    assert core.encode([2, 0]) == [2, 0]
+    assert core.encode([]) == []
+
+
+@needs_native
+def test_bpe_core_rank_priority():
+    # rank 0 = (b,c)->5 must fire before rank 1 = (a,b)->3
+    core = native.BPECore([(1, 2, 5), (0, 1, 3)])
+    assert core.encode([0, 1, 2]) == [0, 5]
+
+
+def _toy_tokenizer():
+    vocab = {ch: i for i, ch in enumerate("abcdef")}
+    vocab.update({"ab": 6, "cd": 7, "abcd": 8, "ef": 9, "<s>": 10, "</s>": 11})
+    merges = ["a b", "c d", "ab cd", "e f"]
+    return BPETokenizer(vocab, merges)
+
+
+@needs_native
+def test_tokenizer_native_path_active_and_matches_python():
+    tok = _toy_tokenizer()
+    assert tok._native is not None
+    for text in ["abcdef", "abcabc", "fedcba", "aabbccddeeff", "", "abcd" * 50]:
+        native_ids = tok.encode(text, bos=False)
+        tok2 = _toy_tokenizer()
+        tok2._native = None  # force the python string path
+        assert native_ids == tok2.encode(text, bos=False), text
+        assert tok.decode(native_ids) == text
+
+
+@needs_native
+def test_tokenizer_falls_back_on_unknown_char():
+    tok = _toy_tokenizer()
+    ids = tok.encode("abzab", bos=False)  # 'z' not in vocab -> python path
+    # python path merges around the unknown; 'z' maps to id 0 ('a'): lossy but safe
+    assert tok.decode(ids) == "abaab"
+
+
+def test_tokenizer_without_native_merges_gate():
+    # merged piece 'xy' missing from vocab -> native gate must decline
+    vocab = {"x": 0, "y": 1}
+    tok = BPETokenizer(vocab, ["x y"])
+    assert tok._native is None
+    assert tok.encode("xy", bos=False) == [0, 1]
+
+
+@needs_native
+def test_pad_batch_matches_numpy():
+    rows = [[1, 2, 3], [4], [], [5, 6, 7, 8, 9]]
+    out = native.pad_batch(rows, max_len=4, pad_id=-1)
+    expected = np.array([[1, 2, 3, -1],
+                         [4, -1, -1, -1],
+                         [-1, -1, -1, -1],
+                         [6, 7, 8, 9]], dtype=np.int32)  # overlong keeps tail
+    np.testing.assert_array_equal(out, expected)
+    assert out.dtype == np.int32
+
+
+@needs_native
+def test_pad_batch_empty():
+    out = native.pad_batch([], max_len=4)
+    assert out.shape == (0, 4)
+
+
+def test_utf8_complete_prefix():
+    s = "héllo…🙂".encode("utf-8")
+    # full string is complete
+    assert native.utf8_complete_prefix(s) == len(s)
+    # chop the 4-byte emoji mid-sequence: prefix must stop before it
+    cut = s[:-2]
+    n = native.utf8_complete_prefix(cut)
+    assert n == len(s) - 4
+    cut[:n].decode("utf-8")  # must not raise
+    assert native.utf8_complete_prefix(b"") == 0
+    assert native.utf8_complete_prefix(b"abc") == 3
+
+
+@needs_native
+def test_utf8_complete_prefix_matches_python_fallback():
+    import ctypes
+
+    def py_mirror(buf: bytes) -> int:
+        if not buf:
+            return 0
+        i = len(buf) - 1
+        back = 0
+        while i > 0 and (buf[i] & 0xC0) == 0x80 and back < 3:
+            i -= 1
+            back += 1
+        lead = buf[i]
+        if (lead & 0x80) == 0:
+            need = 1
+        elif (lead & 0xE0) == 0xC0:
+            need = 2
+        elif (lead & 0xF0) == 0xE0:
+            need = 3
+        elif (lead & 0xF8) == 0xF0:
+            need = 4
+        else:
+            return len(buf)
+        return len(buf) if i + need <= len(buf) else i
+
+    cases = [b"abc", "é".encode()[:1], "🙂".encode()[:3], b"\xff\xfe",
+             "aé🙂".encode(), "aé🙂".encode()[:-1], b"\x80\x80", b"a\xc3"]
+    lib = native._load()
+    for buf in cases:
+        arr = (ctypes.c_uint8 * max(len(buf), 1)).from_buffer_copy(
+            buf or b"\x00")
+        got = lib.gn_utf8_complete_prefix(arr, len(buf))
+        assert got == py_mirror(buf), buf
+        # whatever we cut must decode cleanly when the tail was merely
+        # incomplete (valid-prefix cases)
+        if got < len(buf):
+            buf[:got].decode("utf-8")

@@ -286,3 +286,24 @@ def test_engine_pow2_split():
     assert _pow2_split(64, 64) == [64]
     assert _pow2_split(5, 4) == [4, 1]
     assert _pow2_split(1, 8) == [1]
+
+
+def test_engine_stop_unblocks_active_requests():
+    """stop() must fail mid-generation requests, never deadlock their clients."""
+    from gofr_tpu.models.llama import LlamaConfig, llama_init
+    from gofr_tpu.tpu.engine import LLMEngine
+
+    cfg = LlamaConfig.debug()
+    params = llama_init(cfg, seed=0)
+    # budget far beyond what the post-stop drain (pipeline_depth * block
+    # tokens) can finish, so the slot is still active at loop exit
+    eng = LLMEngine(params, cfg, n_slots=2, max_seq_len=256,
+                    prefill_buckets=(8,), decode_block_size=4,
+                    pipeline_depth=2, logger=MockLogger())
+    eng.start()
+    req = eng.submit([1, 2, 3], max_new_tokens=250, temperature=0.0)
+    while req.generated == 0:  # wait until admitted into a slot
+        time.sleep(0.01)
+    eng.stop()
+    with pytest.raises(RuntimeError, match="engine stopped"):
+        req.result(timeout_s=30)
