@@ -1,0 +1,183 @@
+"""Checkpoint manager + artifact store: roundtrip, atomicity, upgrades."""
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gofr_tpu.checkpoint import ArtifactStore, CheckpointManager
+
+
+def _tree():
+    return {"emb": {"w": jnp.arange(12, dtype=jnp.float32).reshape(3, 4)},
+            "layers": [{"w": jnp.ones((2, 2), dtype=jnp.bfloat16)},
+                       {"w": jnp.zeros((2, 2), dtype=jnp.bfloat16)}],
+            "scale": jnp.float32(2.5)}
+
+
+def test_save_restore_dict_tree_without_like(tmp_path):
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save(7, _tree(), metadata={"note": "hi"})
+    assert mgr.latest_step() == 7
+    out = mgr.restore()
+    np.testing.assert_array_equal(out["emb"]["w"],
+                                  np.arange(12, dtype=np.float32).reshape(3, 4))
+    assert isinstance(out["layers"], list) and len(out["layers"]) == 2
+    assert str(out["layers"][0]["w"].dtype) == "bfloat16"
+    assert float(out["scale"]) == 2.5
+    assert mgr.manifest()["metadata"]["note"] == "hi"
+
+
+def test_restore_with_like_handles_namedtuples(tmp_path):
+    import optax
+
+    params = {"w": jnp.ones((4, 4)), "b": jnp.zeros((4,))}
+    opt = optax.adamw(1e-3)
+    opt_state = opt.init(params)
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save(1, {"params": params, "opt": opt_state})
+    with pytest.raises(ValueError, match="like="):
+        mgr.restore()  # namedtuple nodes need a target
+    like = {"params": params, "opt": opt.init(params)}
+    out = mgr.restore(like=like)
+    assert type(out["opt"]) is type(opt_state)
+    np.testing.assert_array_equal(out["params"]["w"], np.ones((4, 4)))
+
+
+def test_structure_mismatch_rejected(tmp_path):
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save(1, {"a": jnp.ones(3)})
+    with pytest.raises(ValueError, match="mismatch"):
+        mgr.restore(like={"a": jnp.ones(3), "b": jnp.ones(3)})
+
+
+def test_gc_keeps_last_n(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), max_to_keep=2)
+    for step in (1, 2, 3, 4):
+        mgr.save(step, {"x": jnp.ones(2)})
+    assert mgr.steps() == [3, 4]
+
+
+def test_no_torn_checkpoint_on_disk(tmp_path):
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save(5, _tree())
+    entries = os.listdir(str(tmp_path))
+    assert entries == ["ckpt_0000000005"]
+    assert sorted(os.listdir(tmp_path / "ckpt_0000000005")) == [
+        "arrays.npz", "manifest.json"]
+
+
+def test_train_resume_equivalence(tmp_path):
+    """Save at step k, restore, continue — identical to uninterrupted run."""
+    import jax
+
+    from gofr_tpu.train import make_train_step
+
+    def fwd(params, tokens):
+        return jnp.einsum("bt,vd->btd", tokens.astype(jnp.float32) * 0 + 1.0,
+                          params["emb"])[:, :, :8]
+
+    params = {"emb": jax.random.normal(jax.random.PRNGKey(0), (3, 8))}
+    init_opt, step_fn = make_train_step(fwd, remat=False)
+    opt_state = init_opt(params)
+    step = jax.jit(step_fn)
+    tokens = jnp.zeros((2, 4), dtype=jnp.int32)
+    targets = jnp.ones((2, 4), dtype=jnp.int32)
+
+    # uninterrupted: two steps
+    p_ref, s_ref = params, opt_state
+    for _ in range(2):
+        p_ref, s_ref, _ = step(p_ref, s_ref, tokens, targets)
+
+    # interrupted: one step, checkpoint, restore, one step
+    p1, s1, _ = step(params, opt_state, tokens, targets)
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save(1, {"params": p1, "opt": s1})
+    restored = mgr.restore(like={"params": params, "opt": init_opt(params)})
+    p2, s2, _ = step(restored["params"], restored["opt"], tokens, targets)
+    np.testing.assert_allclose(np.asarray(p2["emb"]), np.asarray(p_ref["emb"]),
+                               rtol=1e-6)
+
+
+# -- artifact store -----------------------------------------------------------
+def test_artifact_publish_load_versions(tmp_path):
+    store = ArtifactStore(str(tmp_path))
+    v1 = store.publish("mlp", {"w": jnp.ones((2, 2))}, {"dim": 2})
+    v2 = store.publish("mlp", {"w": jnp.full((2, 2), 2.0)}, {"dim": 2})
+    assert (v1, v2) == (1, 2)
+    params, meta = store.load("mlp")  # latest
+    np.testing.assert_array_equal(params["w"], np.full((2, 2), 2.0))
+    assert meta["config"] == {"dim": 2}
+    params1, _ = store.load("mlp", version=1)
+    np.testing.assert_array_equal(params1["w"], np.ones((2, 2)))
+    with pytest.raises(ValueError, match="already published"):
+        store.publish("mlp", {}, {}, version=2)
+
+
+def test_artifact_upgrades_watermarked(tmp_path):
+    store = ArtifactStore(str(tmp_path))
+    store.publish("m", {"w": jnp.ones((2,))}, {})
+    upgrades = {
+        1: lambda p, cfg: {"w": p["w"] * 2},
+        2: lambda p, cfg: {"w": p["w"] + 1},
+    }
+    assert store.apply_upgrades("m", upgrades) == [1, 2]
+    params, meta = store.load("m")
+    np.testing.assert_array_equal(params["w"], np.full((2,), 3.0))
+    assert meta["upgrades_applied"] == [1, 2]
+    # rerun is a no-op; a later upgrade applies incrementally
+    assert store.apply_upgrades("m", upgrades) == []
+    upgrades[3] = lambda p, cfg: {"w": p["w"] * 10}
+    assert store.apply_upgrades("m", upgrades) == [3]
+    params, _ = store.load("m")
+    np.testing.assert_array_equal(params["w"], np.full((2,), 30.0))
+
+
+def test_artifact_missing_name(tmp_path):
+    store = ArtifactStore(str(tmp_path))
+    with pytest.raises(FileNotFoundError):
+        store.load("ghost")
+    with pytest.raises(ValueError):
+        store.publish("../evil", {}, {})
+
+
+def test_int_keyed_dicts_survive_like_free_restore(tmp_path):
+    mgr = CheckpointManager(str(tmp_path))
+    tree = {"layers": {0: {"w": jnp.ones(2)}, 1: {"w": jnp.zeros(2)}},
+            "stack": [jnp.ones(1), jnp.zeros(1)]}
+    mgr.save(1, tree)
+    out = mgr.restore()
+    assert isinstance(out["layers"], dict)  # int-KEYED dict, not a list
+    np.testing.assert_array_equal(out["layers"][0]["w"], np.ones(2))
+    assert isinstance(out["stack"], list)
+
+
+def test_crash_between_renames_recovers(tmp_path):
+    """A .old left by a crash mid-save must be healed on next access."""
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save(3, {"w": jnp.ones(2)})
+    # simulate the crash window: old moved aside, replacement never landed
+    os.rename(tmp_path / "ckpt_0000000003", tmp_path / "ckpt_0000000003.old")
+    assert mgr.latest_step() == 3
+    out = mgr.restore(3)
+    np.testing.assert_array_equal(out["w"], np.ones(2))
+
+
+def test_save_over_existing_step_never_drops_data(tmp_path):
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save(0, {"w": jnp.ones(2)})
+    mgr.save(0, {"w": jnp.full((2,), 7.0)})
+    out = mgr.restore(0)
+    np.testing.assert_array_equal(out["w"], np.full((2,), 7.0))
+    assert os.listdir(tmp_path) == ["ckpt_0000000000"]
+
+
+def test_artifact_missing_version_leaves_no_phantom(tmp_path):
+    store = ArtifactStore(str(tmp_path))
+    store.publish("m", {"w": jnp.ones(2)}, {})
+    with pytest.raises(FileNotFoundError, match="no version 5"):
+        store.load("m", version=5)
+    assert store.versions("m") == [1]  # no phantom v5 directory
+    params, _ = store.load("m")  # latest still resolves to v1
+    np.testing.assert_array_equal(params["w"], np.ones(2))
