@@ -1,6 +1,8 @@
-"""Hot-path ops: pallas flash attention + ring attention for long context."""
+"""Hot-path ops: pallas flash attention + ring/Ulysses sequence parallelism."""
 
 from .flash_attention import attention_reference, flash_attention
 from .ring_attention import ring_attention
+from .ulysses import ulysses_attention
 
-__all__ = ["attention_reference", "flash_attention", "ring_attention"]
+__all__ = ["attention_reference", "flash_attention", "ring_attention",
+           "ulysses_attention"]
