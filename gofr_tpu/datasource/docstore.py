@@ -26,7 +26,6 @@ from typing import Any, Dict, List, Optional
 from ..logging import PrettyPrint
 from . import Health, STATUS_DOWN, STATUS_UP
 
-_id_counter = itertools.count(1)
 
 
 class DocLog(PrettyPrint):
@@ -81,6 +80,7 @@ class DocumentStore:
         self._lock = threading.RLock()
         self._connected = False
         self._path: Optional[str] = self.config.get("path") or None
+        self._id_counter = itertools.count(1)
 
     # -- provider wiring (mongo.go:41-74) -------------------------------------
     def use_logger(self, logger) -> None:
@@ -96,6 +96,12 @@ class DocumentStore:
         if self._path and os.path.exists(self._path):
             with open(self._path, "r", encoding="utf-8") as fp:
                 self._collections = json.load(fp)
+            # seed the id counter past every persisted integer _id so a
+            # restarted process never reissues an id
+            max_id = max((doc["_id"] for docs in self._collections.values()
+                          for doc in docs if isinstance(doc.get("_id"), int)),
+                         default=0)
+            self._id_counter = itertools.count(max_id + 1)
         self._connected = True
         if self.logger is not None:
             self.logger.infof("document store connected (%s)",
@@ -132,7 +138,7 @@ class DocumentStore:
         self._require_connected()
         start = time.time()
         doc = copy.deepcopy(document)
-        doc.setdefault("_id", next(_id_counter))
+        doc.setdefault("_id", next(self._id_counter))
         with self._lock:
             self._coll(collection).append(doc)
             self._persist()
@@ -147,7 +153,7 @@ class DocumentStore:
         with self._lock:
             for document in documents:
                 doc = copy.deepcopy(document)
-                doc.setdefault("_id", next(_id_counter))
+                doc.setdefault("_id", next(self._id_counter))
                 self._coll(collection).append(doc)
                 ids.append(doc["_id"])
             self._persist()
@@ -188,15 +194,54 @@ class DocumentStore:
                 update: Dict[str, Any], many: bool) -> int:
         self._require_connected()
         start = time.time()
-        fields = update.get("$set", update)
+        operators = {k: v for k, v in update.items() if k.startswith("$")}
+        if operators:
+            unsupported = set(operators) - {"$set", "$unset", "$inc"}
+            if unsupported:  # match _matches' posture: raise, don't corrupt
+                raise ValueError(
+                    f"unsupported update operator(s) {sorted(unsupported)}")
+            plain = {k: v for k, v in update.items() if not k.startswith("$")}
+            if plain:
+                raise ValueError("cannot mix update operators with plain fields")
+
+        def apply(d: Dict[str, Any]) -> None:
+            if not operators:
+                d.update(copy.deepcopy(update))
+                return
+            for key, value in operators.get("$set", {}).items():
+                d[key] = copy.deepcopy(value)
+            for key in operators.get("$unset", {}):
+                d.pop(key, None)
+            for key, delta in operators.get("$inc", {}).items():
+                d[key] = d.get(key, 0) + delta
+
         count = 0
         with self._lock:
+            targets = []
             for d in self._coll(collection):
                 if _matches(d, filter):
-                    d.update(copy.deepcopy(fields))
-                    count += 1
+                    targets.append(d)
                     if not many:
                         break
+            # validate $inc against every target BEFORE mutating anything so
+            # a type error cannot leave a partially-applied, unpersisted
+            # batch; the value checked is the POST-$set/$unset one, since
+            # apply() runs $set/$unset first
+            for key in operators.get("$inc", {}):
+                for d in targets:
+                    if key in operators.get("$set", {}):
+                        value = operators["$set"][key]
+                    elif key in operators.get("$unset", {}):
+                        value = 0
+                    else:
+                        value = d.get(key, 0)
+                    if not isinstance(value, (int, float)) or isinstance(value, bool):
+                        raise ValueError(
+                            f"$inc target field {key!r} is non-numeric "
+                            f"({type(value).__name__})")
+            for d in targets:
+                apply(d)
+                count += 1
             self._persist()
         self._observe("updateMany" if many else "updateOne", collection, start)
         return count

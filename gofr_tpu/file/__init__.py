@@ -59,18 +59,13 @@ class Zip:
             for info in archive.infolist():
                 if info.is_dir():
                     continue
-                # guard before reading: trust the header first, verify after
+                # header-sum guard before decompressing anything; zipfile
+                # itself enforces file_size during read (BadZipFile on lie)
                 total += info.file_size
                 if total > max_bytes:
                     raise ZipBombError(
                         f"decompressed size exceeds {max_bytes} bytes")
-                content = archive.read(info)
-                if len(content) > info.file_size:
-                    total += len(content) - info.file_size
-                    if total > max_bytes:
-                        raise ZipBombError(
-                            f"decompressed size exceeds {max_bytes} bytes")
-                files[info.filename] = File(info.filename, content)
+                files[info.filename] = File(info.filename, archive.read(info))
         return cls(files)
 
     @classmethod

@@ -132,3 +132,62 @@ def test_docstore_app_wiring():
     s.insert_one("t", {"a": 1})  # exercises the metrics histogram path
     health = c.health()
     assert health["details"]["docstore"]["status"] == STATUS_UP
+
+
+def test_docstore_restart_does_not_reissue_ids(tmp_path):
+    from gofr_tpu.datasource.docstore import DocumentStore
+
+    path = str(tmp_path / "docs.json")
+    s1 = DocumentStore({"path": path})
+    s1.connect()
+    first = s1.insert_one("c", {"n": 1})
+    # fresh process over the same file: counter must seed past persisted ids
+    s2 = DocumentStore({"path": path})
+    s2.connect()
+    second = s2.insert_one("c", {"n": 2})
+    assert second != first
+    assert s2.count_documents("c", {"_id": second}) == 1
+
+
+def test_docstore_update_operators(tmp_path):
+    from gofr_tpu.datasource.docstore import DocumentStore
+
+    s = DocumentStore()
+    s.connect()
+    s.insert_one("c", {"name": "a", "n": 1, "tmp": True})
+    assert s.update_one("c", {"name": "a"},
+                        {"$set": {"name": "b"}, "$unset": {"tmp": ""},
+                         "$inc": {"n": 2}}) == 1
+    doc = s.find_one("c", {"name": "b"})
+    assert doc["n"] == 3 and "tmp" not in doc
+    with pytest.raises(ValueError, match="unsupported update operator"):
+        s.update_one("c", {}, {"$push": {"tags": "x"}})
+    with pytest.raises(ValueError, match="mix"):
+        s.update_one("c", {}, {"$set": {"a": 1}, "plain": 2})
+
+
+def test_docstore_inc_validates_before_mutating():
+    from gofr_tpu.datasource.docstore import DocumentStore
+
+    s = DocumentStore()
+    s.connect()
+    s.insert_one("c", {"k": "a", "n": 1})
+    s.insert_one("c", {"k": "b", "n": "oops"})
+    with pytest.raises(ValueError, match="non-numeric"):
+        s.update_many("c", {}, {"$inc": {"n": 1}})
+    # nothing was applied — not even to the valid first document
+    assert s.find_one("c", {"k": "a"})["n"] == 1
+
+
+def test_docstore_inc_checks_post_set_value():
+    from gofr_tpu.datasource.docstore import DocumentStore
+
+    s = DocumentStore()
+    s.connect()
+    s.insert_one("c", {"n": 1})
+    with pytest.raises(ValueError, match="non-numeric"):
+        s.update_one("c", {}, {"$set": {"n": "x"}, "$inc": {"n": 1}})
+    assert s.find_one("c", {})["n"] == 1  # untouched
+    # $unset then $inc starts from 0
+    assert s.update_one("c", {}, {"$unset": {"n": ""}, "$inc": {"n": 5}}) == 1
+    assert s.find_one("c", {})["n"] == 5
