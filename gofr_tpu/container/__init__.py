@@ -99,10 +99,21 @@ class Container:
                             "external: kafka, mqtt, google); pub/sub disabled", backend)
 
         if config.get_bool("TPU_ENABLED", False) or config.get_or_default("MODEL_NAME", ""):
+            # join the multi-host job (if configured) BEFORE the first device
+            # query so jax.devices() is the global set. A configured rank
+            # that cannot join must fail LOUDLY — degrading to single-process
+            # would leave the other ranks blocked at the coordination
+            # barrier (unlike a missing Redis, this is not survivable).
+            from ..parallel.multihost import initialize_from_config
+            initialize_from_config(config, c.logger)
             try:
                 from ..tpu.device import TPUClient
                 c.tpu = TPUClient.from_config(config, c.logger, c.metrics_manager)
             except Exception as exc:  # noqa: BLE001 - boot survives a bad datasource
+                if config.get_or_default("JAX_COORDINATOR_ADDR", ""):
+                    # this host already joined the global device set; serving
+                    # without a TPU client would hang the pod's collectives
+                    raise
                 c.logger.errorf("could not initialise TPU client: %s", exc)
 
         return c

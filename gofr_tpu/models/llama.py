@@ -243,15 +243,22 @@ def llama_decode_step(params, cfg: LlamaConfig, tokens, positions, k_cache, v_ca
     return logits[:, 0, :], k_cache, v_cache
 
 
-def _attention_block_nocache(x, layer, positions, cfg: LlamaConfig):
-    """Plain causal attention sublayer (no cache). x: [B, T, D] -> [B, T, D]."""
+def _attention_block_nocache(x, layer, positions, cfg: LlamaConfig,
+                             attn_fn=None):
+    """Plain causal attention sublayer (no cache). x: [B, T, D] -> [B, T, D].
+
+    attn_fn overrides the attention primitive (q, k, v) -> [B, T, H, dh] —
+    how the sequence-parallel forward swaps in ring/Ulysses attention while
+    sharing every projection with the dense path."""
     B, T, _ = x.shape
     H, Hkv, dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     normed = rms_norm(x, layer["attn_norm"], cfg.rms_eps)
     q = rope((normed @ layer["wq"]).reshape(B, T, H, dh), positions, cfg.rope_theta)
     k = rope((normed @ layer["wk"]).reshape(B, T, Hkv, dh), positions, cfg.rope_theta)
     v = (normed @ layer["wv"]).reshape(B, T, Hkv, dh)
-    if cfg.attn_impl == "flash":
+    if attn_fn is not None:
+        attn = attn_fn(q, k, v)
+    elif cfg.attn_impl == "flash":
         from ..ops.flash_attention import flash_attention
 
         attn = flash_attention(q, k, v, True)
@@ -262,6 +269,25 @@ def _attention_block_nocache(x, layer, positions, cfg: LlamaConfig):
     return attn.reshape(B, T, H * dh) @ layer["wo"]
 
 
+def forward_nocache_at(params, cfg: LlamaConfig, tokens, positions,
+                       attn_fn=None):
+    """Cache-free forward over a token chunk at explicit absolute positions.
+
+    The shared body behind llama_forward_nocache and the sequence-parallel
+    forward (parallel/longcontext.py), which calls it per device with its
+    chunk's position offset and a collective attention primitive."""
+    x = params["tok_emb"][tokens]
+
+    def body(x, layer):
+        x = x + _attention_block_nocache(x, layer, positions, cfg, attn_fn)
+        x = x + _ffn_block(x, layer, cfg)
+        return x, None
+
+    x, _ = jax.lax.scan(body, x, params["layers"])
+    x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+    return (x @ params["lm_head"]).astype(jnp.float32)
+
+
 def llama_forward_nocache(params, cfg: LlamaConfig, tokens):
     """Training/eval forward without a cache: plain causal attention.
 
@@ -270,13 +296,4 @@ def llama_forward_nocache(params, cfg: LlamaConfig, tokens):
     """
     B, T = tokens.shape
     positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None, :], (B, T))
-    x = params["tok_emb"][tokens]
-
-    def body(x, layer):
-        x = x + _attention_block_nocache(x, layer, positions, cfg)
-        x = x + _ffn_block(x, layer, cfg)
-        return x, None
-
-    x, _ = jax.lax.scan(body, x, params["layers"])
-    x = rms_norm(x, params["final_norm"], cfg.rms_eps)
-    return (x @ params["lm_head"]).astype(jnp.float32)
+    return forward_nocache_at(params, cfg, tokens, positions)

@@ -1,0 +1,103 @@
+"""Multi-host launch: jax.distributed over DCN, mesh spanning all hosts.
+
+SURVEY.md §5 "Distributed communication backend": intra-slice collectives
+ride ICI inside the compiled program; ACROSS hosts the runtime needs (a) a
+coordination plane to form the global device set — `jax.distributed`'s
+coordinator over DCN, configured here from the same env-file Config as
+every other subsystem — and (b) the existing gRPC/HTTP service layer for
+application-level RPC (scheduler fan-out, health), mirroring how the
+reference reaches other processes through its service client
+(service/new.go:68-87) rather than a bespoke transport.
+
+Config keys (configs/.env):
+  JAX_COORDINATOR_ADDR  host:port of process 0 (required to enable)
+  JAX_NUM_PROCESSES     world size
+  JAX_PROCESS_ID        this process's rank
+  JAX_LOCAL_DEVICE_IDS  optional comma list restricting local devices
+
+Single-process use needs none of these — `initialize_from_config` is a
+no-op without JAX_COORDINATOR_ADDR, so the same binary runs a laptop, one
+TPU host, or a pod slice unchanged.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class MultiHostSpec:
+    coordinator: str
+    num_processes: int
+    process_id: int
+    local_device_ids: Optional[List[int]] = None
+
+    @classmethod
+    def from_config(cls, config) -> Optional["MultiHostSpec"]:
+        """Parse the JAX_* keys; None when multi-host is not configured."""
+        coordinator = config.get_or_default("JAX_COORDINATOR_ADDR", "")
+        if not coordinator:
+            return None
+        num = int(config.get_or_default("JAX_NUM_PROCESSES", "1"))
+        pid = int(config.get_or_default("JAX_PROCESS_ID", "0"))
+        if not 0 <= pid < num:
+            raise ValueError(f"JAX_PROCESS_ID {pid} out of range for "
+                             f"JAX_NUM_PROCESSES {num}")
+        raw_ids = config.get_or_default("JAX_LOCAL_DEVICE_IDS", "")
+        ids = [int(x) for x in raw_ids.split(",") if x.strip()] or None
+        return cls(coordinator=coordinator, num_processes=num,
+                   process_id=pid, local_device_ids=ids)
+
+
+def initialize_from_config(config, logger=None) -> Optional[MultiHostSpec]:
+    """Join the multi-host job if configured; otherwise no-op.
+
+    Must run before the first jax device query (the App calls it during
+    container creation when TPU is enabled). Returns the spec when
+    multi-host was initialized.
+    """
+    spec = MultiHostSpec.from_config(config)
+    if spec is None:
+        return None
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=spec.coordinator,
+        num_processes=spec.num_processes,
+        process_id=spec.process_id,
+        local_device_ids=spec.local_device_ids)
+    if logger is not None:
+        logger.infof("joined multi-host job: rank %d/%d via %s",
+                     spec.process_id, spec.num_processes, spec.coordinator)
+    return spec
+
+
+def global_mesh(plan=None, **axis_sizes):
+    """Mesh over ALL processes' devices (jax.devices() is global after
+    initialize). Axis order puts dp outermost so the per-step gradient
+    all-reduce is the only collective that crosses DCN; tp/sp stay inside a
+    host's ICI domain when the factorization allows."""
+    from .mesh import MeshPlan, make_mesh
+
+    if plan is None and not axis_sizes:  # everything else is make_mesh's job
+        import jax
+
+        plan = MeshPlan.factorize(len(jax.devices()))
+    return make_mesh(plan, **axis_sizes)
+
+
+def process_local_batch(global_batch, mesh, spec=None):
+    """Build a globally-sharded array from per-host data.
+
+    Each host passes ITS shard of the batch (the data-loader reads only the
+    rows this process owns); jax.make_array_from_process_local_data stitches
+    the global array without gathering everything to one host.
+    """
+    import jax
+    from jax.sharding import NamedSharding
+
+    from .sharding import batch_spec
+
+    sharding = NamedSharding(mesh, spec if spec is not None else batch_spec())
+    return jax.make_array_from_process_local_data(sharding, global_batch)

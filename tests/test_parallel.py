@@ -286,3 +286,89 @@ def test_ulysses_rejects_indivisible_heads():
             lambda q, k, v: ulysses_attention(q, k, v, axis_name="sp"),
             mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
             check_vma=False)(q, q, q)
+
+
+# -- sequence-parallel llama forward ------------------------------------------
+def test_sp_llama_forward_matches_dense():
+    from gofr_tpu.parallel.longcontext import sp_llama_forward
+
+    cfg = LlamaConfig.debug()
+    params = llama_init(cfg, seed=0)
+    rng = np.random.default_rng(7)
+    tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 32)),
+                         dtype=jnp.int32)
+    expected = llama_forward_nocache(params, cfg, tokens)
+    mesh = make_mesh(MeshPlan(dp=2, sp=4))
+    for attn in ("ring", "ulysses"):
+        got = jax.jit(lambda p, t, a=attn: sp_llama_forward(
+            p, cfg, t, mesh, attn=a))(params, tokens)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                                   rtol=2e-4, atol=2e-4, err_msg=attn)
+
+
+def test_sp_llama_forward_trains():
+    from gofr_tpu.parallel.longcontext import make_sp_forward
+
+    cfg = LlamaConfig.debug()
+    params = llama_init(cfg, seed=0)
+    mesh = make_mesh(MeshPlan(sp=8))
+    init_opt, train_step = make_train_step(make_sp_forward(cfg, mesh),
+                                           remat=False)
+    opt_state = init_opt(params)
+    step = jax.jit(train_step, donate_argnums=(0, 1))
+    rng = np.random.default_rng(0)
+    data = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 33)),
+                       dtype=jnp.int32)
+    losses = []
+    for _ in range(3):
+        params, opt_state, metrics = step(params, opt_state,
+                                          data[:, :-1], data[:, 1:])
+        losses.append(float(metrics["loss"]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+
+
+def test_sp_llama_forward_rejects_indivisible_seq():
+    from gofr_tpu.parallel.longcontext import sp_llama_forward
+
+    cfg = LlamaConfig.debug()
+    params = llama_init(cfg, seed=0)
+    mesh = make_mesh(MeshPlan(sp=8))
+    with pytest.raises(ValueError, match="divide"):
+        sp_llama_forward(params, cfg, jnp.ones((1, 30), dtype=jnp.int32), mesh)
+
+
+# -- multi-host launcher ------------------------------------------------------
+def test_multihost_spec_parsing():
+    from gofr_tpu.config import MockConfig
+    from gofr_tpu.parallel.multihost import MultiHostSpec, initialize_from_config
+
+    # unconfigured -> no-op (single-process path)
+    assert MultiHostSpec.from_config(MockConfig({})) is None
+    assert initialize_from_config(MockConfig({})) is None
+
+    spec = MultiHostSpec.from_config(MockConfig({
+        "JAX_COORDINATOR_ADDR": "10.0.0.1:1234",
+        "JAX_NUM_PROCESSES": "4",
+        "JAX_PROCESS_ID": "2",
+        "JAX_LOCAL_DEVICE_IDS": "0, 1",
+    }))
+    assert spec.coordinator == "10.0.0.1:1234"
+    assert (spec.num_processes, spec.process_id) == (4, 2)
+    assert spec.local_device_ids == [0, 1]
+
+    with pytest.raises(ValueError, match="out of range"):
+        MultiHostSpec.from_config(MockConfig({
+            "JAX_COORDINATOR_ADDR": "x:1", "JAX_NUM_PROCESSES": "2",
+            "JAX_PROCESS_ID": "2"}))
+
+
+def test_process_local_batch_single_process():
+    from gofr_tpu.parallel.multihost import global_mesh, process_local_batch
+
+    mesh = global_mesh(dp=2, sp=2, tp=2)
+    data = np.arange(4 * 8, dtype=np.int32).reshape(4, 8)
+    arr = process_local_batch(data, mesh)
+    assert arr.shape == (4, 8)
+    np.testing.assert_array_equal(np.asarray(arr), data)
+    assert arr.sharding.spec == PartitionSpec("dp", "sp")
