@@ -1,0 +1,81 @@
+"""Pub/sub-ingress LLM worker: north-star config 5's ingress shape.
+
+Generation jobs arrive on the durable `generate.requests` topic instead of
+HTTP (reference pattern: Kafka ingress, subscriber.go:27-57); the handler
+feeds the same continuous-batching engine the HTTP path uses and publishes
+the completion to `generate.results`, committing the job only after the
+result is durably published — crash-safe at-least-once end to end.
+
+Run a producer anywhere on the host:
+
+    from gofr_tpu.pubsub.filebroker import FileBroker
+    import json
+    b = FileBroker(root="./.gofr_pubsub")
+    b.publish("generate.requests",
+              json.dumps({"id": "job-1", "prompt": "hello", "max_tokens": 16}))
+    print(b.subscribe("generate.results", group="reader", timeout_s=60).value)
+
+Several workers sharing PUBSUB_DIR work-share the topic (per-record claims);
+/stats and /.well-known/health stay on HTTP for operability.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from gofr_tpu import App  # noqa: E402
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", "llm-server"))
+from main import build_engine  # noqa: E402  (the llm-server's engine builder)
+
+
+def main() -> None:
+    os.chdir(os.path.dirname(os.path.abspath(__file__)))
+    app = App()
+    engine = build_engine(app)
+    tokenizer = engine.tokenizer
+
+    @app.subscribe("generate.requests")
+    def on_job(ctx):
+        # any malformed payload (non-JSON, non-object, bad field types) is
+        # dropped WITH a commit — raising here would redeliver the poison
+        # message forever and wedge the worker
+        try:
+            job = ctx.bind()
+            prompt = job.get("prompt", "")
+            max_tokens = int(job.get("max_tokens", 64))
+            temperature = float(job.get("temperature", 0.0))
+        except (ValueError, TypeError, AttributeError) as exc:
+            app.logger.errorf("malformed job dropped: %s", exc)
+            return None
+        if not isinstance(prompt, str) or not prompt:
+            app.logger.errorf("job %s: missing prompt; dropping", job.get("id"))
+            return None
+        tokens = engine.generate(
+            tokenizer.encode(prompt),
+            max_new_tokens=max_tokens,
+            temperature=temperature,
+            stop_tokens={tokenizer.EOS})
+        ctx.container.pubsub.publish("generate.results", json.dumps({
+            "id": job.get("id"),
+            "text": tokenizer.decode(tokens),
+            "tokens": len(tokens),
+        }).encode())
+        return None  # returning without raising commits the job
+
+    @app.get("/stats")
+    def stats(ctx):
+        return {
+            "active_slots": sum(1 for s in engine.slots if s.active),
+            "queue_depth": engine._pending.qsize(),
+            "pubsub": ctx.container.pubsub.health_check().details,
+        }
+
+    app.run()
+
+
+if __name__ == "__main__":
+    main()
