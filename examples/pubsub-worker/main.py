@@ -54,8 +54,16 @@ def main() -> None:
         if not isinstance(prompt, str) or not prompt:
             app.logger.errorf("job %s: missing prompt; dropping", job.get("id"))
             return None
+        prompt_tokens = tokenizer.encode(prompt)
+        # an oversized prompt must not become a poison message: truncate to
+        # the engine's admission limit (keeping the tail, the live context)
+        limit = engine.admission_limit
+        if len(prompt_tokens) > limit:
+            app.logger.errorf("job %s: prompt truncated to %d tokens",
+                              job.get("id"), limit)
+            prompt_tokens = prompt_tokens[-limit:]
         tokens = engine.generate(
-            tokenizer.encode(prompt),
+            prompt_tokens,
             max_new_tokens=max_tokens,
             temperature=temperature,
             stop_tokens={tokenizer.EOS})

@@ -163,6 +163,10 @@ def _attention_block(x, layer, k_cache_l, v_cache_l, positions, cfg: LlamaConfig
 
     x: [B, T, D]; k/v_cache_l: [B, S, Hkv, dh]; positions: [B, T].
     Returns (out [B, T, D], k_cache_l, v_cache_l).
+
+    Per-step HBM traffic scales with the ALLOCATED seq dim S, so the engine
+    allocates the cache at the bucket covering the live contexts and grows
+    it on demand (engine._grow_cache) instead of sizing for max_seq_len.
     """
     B, T, D = x.shape
     S = k_cache_l.shape[1]
@@ -180,17 +184,21 @@ def _attention_block(x, layer, k_cache_l, v_cache_l, positions, cfg: LlamaConfig
     k_cache_l = k_cache_l.at[batch_idx, positions].set(k)
     v_cache_l = v_cache_l.at[batch_idx, positions].set(v)
 
-    # GQA attention over the cache: q grouped [B, T, Hkv, G, dh]
+    # GQA attention over the cache: q grouped [B, T, Hkv, G, dh].
+    # Keep the matmul inputs in the cache dtype (bf16 on the MXU's fast
+    # path) and accumulate f32 via preferred_element_type — upcasting the
+    # INPUTS would force a full-f32 matmul at a fraction of MXU throughput.
     qg = q.reshape(B, T, Hkv, G, dh)
-    scores = jnp.einsum("bthgd,bshd->bhgts", qg.astype(jnp.float32),
-                        k_cache_l.astype(jnp.float32)) / math.sqrt(dh)
+    scores = jnp.einsum("bthgd,bshd->bhgts", qg, k_cache_l,
+                        preferred_element_type=jnp.float32) / math.sqrt(dh)
     # mask: query at absolute pos p sees cache slot j iff j <= p
     cache_pos = jnp.arange(S)[None, None, :]                  # [1, 1, S]
     visible = cache_pos <= positions[:, :, None]              # [B, T, S]
     scores = jnp.where(visible[:, None, None, :, :], scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bhgts,bshd->bthgd", probs,
-                     v_cache_l.astype(jnp.float32)).astype(x.dtype)
+    out = jnp.einsum("bhgts,bshd->bthgd", probs.astype(v_cache_l.dtype),
+                     v_cache_l,
+                     preferred_element_type=jnp.float32).astype(x.dtype)
     out = out.reshape(B, T, H * dh) @ layer["wo"]
     return out, k_cache_l, v_cache_l
 
@@ -232,7 +240,8 @@ def llama_prefill(params, cfg: LlamaConfig, tokens, k_cache, v_cache):
     return llama_forward(params, cfg, tokens, positions, k_cache, v_cache)
 
 
-def llama_decode_step(params, cfg: LlamaConfig, tokens, positions, k_cache, v_cache):
+def llama_decode_step(params, cfg: LlamaConfig, tokens, positions, k_cache,
+                      v_cache):
     """One decode step for every batch row.
 
     tokens: [B] current token per row; positions: [B] its absolute position.
