@@ -110,10 +110,15 @@ def main() -> None:
         r.result(timeout_s=600)
     elapsed = time.time() - t0
     counted = sum(r.generated for r in requests)
+    ttfts = sorted(r.first_token_at - r.enqueued_at for r in requests
+                   if r.first_token_at is not None)
 
     engine.stop()
     tok_s = counted / elapsed
     print(f"[bench] {counted} tokens in {elapsed:.2f}s", file=sys.stderr)
+    if ttfts:  # BASELINE.md config 4's second number: p50 TTFT <150 ms
+        print(f"[bench] ttft p50={ttfts[len(ttfts)//2]*1e3:.0f}ms "
+              f"p99={ttfts[int(len(ttfts)*0.99)]*1e3:.0f}ms", file=sys.stderr)
 
     result = {
         "metric": f"decode_tokens_per_sec_{'llama1b_bf16' if on_tpu else 'debug_cpu'}"

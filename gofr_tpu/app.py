@@ -158,6 +158,12 @@ class App:
     def enable_oauth(self, secret: str) -> None:
         self.router.use_middleware(mw.oauth_middleware(secret))
 
+    def enable_profiler(self, path: str = "/debug/profile") -> None:
+        """Expose on-demand xprof device-trace capture (tpu/profiler.py)."""
+        from .tpu.profiler import install_routes
+
+        install_routes(self, path)
+
     # -- cross-cutting registrations ------------------------------------------
     def add_http_service(self, name: str, address: str, *options) -> None:
         from .service import new_http_service

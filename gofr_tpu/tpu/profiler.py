@@ -1,0 +1,76 @@
+"""On-demand device profiling: jax.profiler (xplane/xprof) capture.
+
+SURVEY.md §5 "Tracing / profiling": the reference has OTel spans but no CPU
+profiler integration; the TPU build adds the device side — XLA's profiler at
+the runtime boundary, exposed as an operator endpoint.  A capture writes an
+xplane trace (viewable in TensorBoard / xprof) for every program the engine
+dispatches during the window: prefill/decode HLOs, DMA, scalar-core stalls.
+
+Wire-up: ``app.enable_profiler()`` adds
+
+    POST /debug/profile {"seconds": 2, "dir": "./profiles"}  -> capture, 201
+    GET  /debug/profile                                      -> status
+
+Captures are serialized (one at a time) and bounded (<= 60 s) so a stray
+request cannot pin the trace buffer forever.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Optional
+
+_MAX_SECONDS = 60.0
+
+_lock = threading.Lock()
+_state = {"active": False, "last_dir": None, "last_captured_at": None}
+
+
+def capture_trace(seconds: float, log_dir: str = "./profiles") -> str:
+    """Capture `seconds` of device+host activity into a timestamped subdir.
+
+    Blocks for the duration. Raises RuntimeError if a capture is already
+    running (the profiler is a global singleton in the process).
+    """
+    import jax
+
+    seconds = min(float(seconds), _MAX_SECONDS)
+    if seconds <= 0:
+        raise ValueError("profile duration must be positive")
+    out = os.path.join(log_dir, time.strftime("trace-%Y%m%d-%H%M%S"))
+    with _lock:
+        if _state["active"]:
+            raise RuntimeError("a profile capture is already running")
+        _state["active"] = True
+    try:
+        os.makedirs(out, exist_ok=True)
+        jax.profiler.start_trace(out)
+        time.sleep(seconds)
+        jax.profiler.stop_trace()
+        _state["last_dir"] = out
+        _state["last_captured_at"] = time.time()
+        return out
+    finally:
+        _state["active"] = False
+
+
+def status() -> dict:
+    return dict(_state)
+
+
+def install_routes(app, path: str = "/debug/profile") -> None:
+    """Register the capture/status endpoints on a gofr_tpu App."""
+
+    @app.post(path)
+    def profile(ctx):  # noqa: ANN001
+        body = ctx.bind() or {}
+        seconds = float(body.get("seconds", 2.0))
+        log_dir = str(body.get("dir", "./profiles"))
+        trace_dir = capture_trace(seconds, log_dir)
+        return {"trace_dir": trace_dir, "seconds": min(seconds, _MAX_SECONDS)}
+
+    @app.get(path)
+    def profile_status(ctx):  # noqa: ANN001
+        return status()

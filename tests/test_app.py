@@ -183,3 +183,26 @@ def test_mock_container_for_handler_unit_tests():
 
     ctx = Context(request=Request("GET", "/"), container=container)
     assert handler(ctx) == "hi"
+
+
+def test_profiler_endpoint(tmp_path):
+    app = make_app()
+    app.enable_profiler()
+    app.start()
+    try:
+        base = f"http://127.0.0.1:{app.http_port}"
+        r = requests.get(f"{base}/debug/profile")
+        assert r.status_code == 200
+        assert r.json()["data"]["active"] is False
+        r = requests.post(f"{base}/debug/profile",
+                          json={"seconds": 0.2, "dir": str(tmp_path)})
+        assert r.status_code == 201
+        trace_dir = r.json()["data"]["trace_dir"]
+        assert trace_dir.startswith(str(tmp_path))
+        import os
+
+        assert os.path.isdir(trace_dir)  # xplane capture landed
+        status = requests.get(f"{base}/debug/profile").json()["data"]
+        assert status["last_dir"] == trace_dir
+    finally:
+        app.shutdown()
