@@ -306,10 +306,15 @@ class LLMEngine:
                 jnp.arange(bucket, dtype=jnp.int32)[None, :], (K, bucket))
             logits, tmp_k, tmp_v = llama_forward(params, cfg, ptokens, pos_grid,
                                                  tmp_k, tmp_v)
-            row = slots[:, None]                       # [K, 1]
-            col = jnp.arange(bucket, dtype=jnp.int32)[None, :]  # [1, bucket]
-            k_cache = k_cache.at[:, row, col].set(tmp_k)
-            v_cache = v_cache.at[:, row, col].set(tmp_v)
+            # splice: scatter rows along the batch axis with a STATIC seq
+            # slice — a 2D (row, col) advanced-index scatter lowers to a
+            # full-cache gather/scatter pass, this form to a bounded one
+            if bucket == S:
+                k_cache = k_cache.at[:, slots].set(tmp_k)
+                v_cache = v_cache.at[:, slots].set(tmp_v)
+            else:
+                k_cache = k_cache.at[:, slots, :bucket].set(tmp_k)
+                v_cache = v_cache.at[:, slots, :bucket].set(tmp_v)
             last = logits[jnp.arange(K), lengths - 1]  # [K, V]
             first, rng = sample_tokens(last, rng, new_temps, top_k=top_k)
             tokens = tokens.at[slots].set(first)
