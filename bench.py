@@ -1,16 +1,21 @@
-"""Headline benchmark: continuous-batching decode throughput (tokens/sec).
+"""Headline benchmark: continuous-batching serving throughput + TTFT.
 
 Run by the driver on real TPU hardware at the end of each round; prints ONE
-JSON line {"metric", "value", "unit", "vs_baseline"}.
+JSON line {"metric", "value", "unit", "vs_baseline", ...}.
 
-What it measures: steady-state output tokens/sec of the LLMEngine (the full
-serving path — compiled decode step, donated KV cache, on-device sampling,
-host demux) on a Llama-1B-class model, bf16, fully-occupied slots. This is
-the per-chip number behind BASELINE.md config 4's target (2000 tok/s for
-8B on 8 chips ~= one 1B-chip-equivalent per chip); vs_baseline = value/2000.
+What it measures (BASELINE.md config 4), three phases on one engine:
+  T0 — round-1-comparable decode throughput: 8-token prompts, short
+    contexts, small KV allocation (the config the 4918 tok/s round-1 claim
+    was measured under). This is the PRIMARY metric for round-over-round
+    continuity; vs_baseline = value / 2000 (config-4 per-chip target).
+  T1 — honest serving throughput under a REALISTIC prompt mix (64-512
+    token prompts, slot turnover, grown cache).
+  L  — p50/p99 TTFT under a Poisson arrival process at ~70% of measured
+    capacity (queue wait + prefill + pipeline sync, not a burst).
+T1/L ride in the same JSON object under "extras".
 
-On CPU (no TPU available) it falls back to the debug model so the harness
-still emits a line; the vs_baseline denominator stays 2000 for continuity.
+On CPU (no TPU acquired) it falls back to the debug model so the harness
+still emits a line, and reports WHY in "fallback_reason".
 """
 
 import json
@@ -21,16 +26,22 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 BASELINE_TOK_S = 2000.0
+BENCH_BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "1500"))
+_T0 = time.time()
 
 
-def _probe_accelerator(timeout_s: float = 240.0) -> bool:
-    """Check for a usable accelerator in a SUBPROCESS with a timeout.
+def _left() -> float:
+    return BENCH_BUDGET_S - (time.time() - _T0)
+
+
+def _probe_once(timeout_s: float):
+    """One accelerator probe in a killable SUBPROCESS.
 
     The axon TPU tunnel is single-tenant and can hang indefinitely in
     PJRT_Client_Create if a previous client died uncleanly; probing in a
-    killable child keeps the bench itself from wedging, and on failure the
-    parent pins jax to CPU before ever touching the plugin.
-    """
+    child keeps the bench itself from wedging, and on failure the parent
+    pins jax to CPU before ever touching the plugin.
+    Returns (ok, reason)."""
     import subprocess
 
     try:
@@ -41,13 +52,92 @@ def _probe_accelerator(timeout_s: float = 240.0) -> bool:
              "print(d[0].platform)"],
             capture_output=True, timeout=timeout_s, text=True)
         platform = out.stdout.strip().splitlines()[-1] if out.stdout.strip() else ""
-        return out.returncode == 0 and platform not in ("", "cpu")
-    except (subprocess.TimeoutExpired, OSError):
-        return False
+        if out.returncode == 0 and platform not in ("", "cpu"):
+            return True, platform
+        tail = (out.stderr or "").strip().splitlines()[-1:] or ["no stderr"]
+        return False, f"probe rc={out.returncode} platform={platform!r} ({tail[0][:160]})"
+    except Exception as exc:  # TimeoutExpired, OSError
+        return False, f"probe {type(exc).__name__}"
+
+
+def _probe_accelerator():
+    """Probe with retry + backoff: a wedged PJRT tunnel recovers after the
+    stale client's lease lapses (minutes), so one attempt under-reports.
+    Returns (on_tpu, reason)."""
+    reason = "unknown"
+    for attempt, (timeout_s, sleep_s) in enumerate(
+            [(180.0, 30.0), (120.0, 60.0), (150.0, 0.0)]):
+        if _left() < timeout_s + 120:  # keep room for the CPU fallback run
+            return False, f"probe budget exhausted after attempt {attempt} ({reason})"
+        ok, reason = _probe_once(timeout_s)
+        if ok:
+            return True, reason
+        print(f"[bench] probe attempt {attempt + 1} failed: {reason}; "
+              f"retrying in {sleep_s:.0f}s", file=sys.stderr)
+        if sleep_s:
+            time.sleep(sleep_s)
+    return False, reason
+
+
+def _prompt_mix(rng, n, vocab, limit):
+    """Realistic prompt lengths: log-ish mix over 64-512, weighted to the
+    128-256 middle (chat/RAG-shaped), capped to the engine admission limit."""
+    lengths = rng.choice([64, 96, 128, 192, 256, 384, 512],
+                         size=n, p=[.12, .14, .22, .20, .16, .10, .06])
+    return [rng.integers(1, vocab, size=min(int(L), limit)).tolist()
+            for L in lengths]
+
+
+def _percentiles(xs):
+    xs = sorted(xs)
+    if not xs:
+        return 0.0, 0.0
+    return xs[len(xs) // 2], xs[min(len(xs) - 1, int(len(xs) * 0.99))]
+
+
+def run_phase_throughput(engine, prompts, max_new, rounds=1):
+    """Saturate the engine with 2x slots of mixed prompts; measure emitted
+    tokens/sec from first submit to last completion (includes prefill —
+    the honest serving number)."""
+    for _ in range(rounds):  # warm: drives cache growth + compiles hot
+        warm = [engine.submit(p, max_new_tokens=max_new, temperature=0.0)
+                for p in prompts]
+        for r in warm:
+            r.result(timeout_s=900)
+
+    t0 = time.time()
+    reqs = [engine.submit(p, max_new_tokens=max_new, temperature=0.0)
+            for p in prompts]
+    for r in reqs:
+        r.result(timeout_s=900)
+    elapsed = time.time() - t0
+    tokens = sum(r.generated for r in reqs)
+    ttfts = [r.first_token_at - r.enqueued_at for r in reqs
+             if r.first_token_at is not None]
+    return tokens / elapsed, tokens, elapsed, ttfts
+
+
+def run_phase_latency(engine, prompts, max_new, rate_rps, duration_s, rng):
+    """Poisson arrivals at rate_rps for duration_s; returns TTFT list.
+
+    Draining sequentially is fine: TTFT is stamped by the engine loop at
+    sync time, not by the consumer, and per-request queues are unbounded."""
+    reqs = []
+    t_end = time.time() + duration_s
+    while time.time() < t_end:
+        reqs.append(engine.submit(prompts[len(reqs) % len(prompts)],
+                                  max_new_tokens=max_new, temperature=0.0))
+        time.sleep(float(rng.exponential(1.0 / rate_rps)))
+    for r in reqs:
+        r.result(timeout_s=900)
+    return [r.first_token_at - r.enqueued_at for r in reqs
+            if r.first_token_at is not None]
 
 
 def main() -> None:
-    on_tpu = _probe_accelerator()
+    import numpy as np
+
+    on_tpu, reason = _probe_accelerator()
     import jax
 
     if not on_tpu:
@@ -62,63 +152,82 @@ def main() -> None:
 
     if on_tpu:
         cfg = LlamaConfig.llama1b()
-        n_slots = 128
-        max_new = 128
-        max_seq = 512
+        n_slots, max_new, max_seq = 128, 128, 1024
+        prefill_buckets = (16, 64, 128, 256, 512)
+        full_run = True
     else:
         cfg = LlamaConfig.debug()
-        n_slots = 8
-        max_new = 64
-        max_seq = 256
+        n_slots, max_new, max_seq = 8, 32, 256
+        prefill_buckets = (16, 64, 128)
+        full_run = False
 
-    print(f"[bench] platform={platform} model={cfg.dim}d x {cfg.n_layers}L "
+    print(f"[bench] platform={platform} tpu={on_tpu} ({reason}) "
+          f"model={cfg.dim}d x {cfg.n_layers}L "
           f"({cfg.param_count()/1e9:.2f}B params) slots={n_slots}",
           file=sys.stderr)
 
+    rng = np.random.default_rng(0)
     t0 = time.time()
     params = llama_init(cfg, seed=0)
     # block/depth from a sweep on v5e: small blocks turn finished slots over
     # faster and keep the growth margin tight; depth 2 is enough to hide
     # dispatch latency (deeper just inflates the in-flight margin)
     engine = LLMEngine(params, cfg, n_slots=n_slots, max_seq_len=max_seq,
-                       prefill_buckets=(16,), decode_block_size=8,
+                       prefill_buckets=prefill_buckets, decode_block_size=8,
                        pipeline_depth=2, seed=0)
     engine.start()
-    engine.warmup()
+    # grow=False: T0 must run at the small boot-time allocation (the r01
+    # measurement condition); T1's warm round grows the cache on demand
+    engine.warmup(grow=False)
     print(f"[bench] init+warmup {time.time()-t0:.1f}s", file=sys.stderr)
+    extras = {}
 
-    prompt = [1, 2, 3, 4, 5, 6, 7, 8]
+    # ---- T0: round-1-comparable decode throughput (short prompts) ---------
+    short_prompts = [rng.integers(1, cfg.vocab_size, size=8).tolist()
+                     for _ in range(n_slots)]
+    tok_s, tokens, elapsed, t0_ttfts = run_phase_throughput(
+        engine, short_prompts, max_new, rounds=2 if full_run else 1)
+    print(f"[bench] T0 short-prompt decode: {tokens} tok in {elapsed:.2f}s = "
+          f"{tok_s:.1f} tok/s", file=sys.stderr)
 
-    # TWO warm rounds with the measured round's token budget: the first
-    # drives the cache through its growth sequence (compiling decode at each
-    # size), the second runs entirely at the final size so the batched
-    # prefill program for that size is also hot — the measured round then
-    # sees steady state, no compiles
-    for _ in range(2):
-        warm = [engine.submit(prompt, max_new_tokens=max_new, temperature=0.0)
-                for _ in range(n_slots)]
-        for r in warm:
-            r.result(timeout_s=600)
+    # ---- T1: honest mixed-prompt serving throughput -----------------------
+    prompts = _prompt_mix(rng, 2 * n_slots, cfg.vocab_size,
+                          engine.admission_limit)
+    mean_len = sum(len(p) for p in prompts) / len(prompts)
+    if _left() > 300 or not full_run:
+        mixed_tok_s, tokens, elapsed, burst_ttfts = run_phase_throughput(
+            engine, prompts, max_new, rounds=2 if full_run else 1)
+        print(f"[bench] T1 mixed-prompt serve: {tokens} tok in {elapsed:.2f}s "
+              f"= {mixed_tok_s:.1f} tok/s (mean prompt {mean_len:.0f})",
+              file=sys.stderr)
+        extras.update(mixed_prompt_tok_s=round(mixed_tok_s, 1),
+                      mean_prompt_len=round(mean_len, 1))
+    else:
+        mixed_tok_s, burst_ttfts = 0.0, t0_ttfts  # fall back to T0's TTFTs
+        extras["mixed_prompt_skipped"] = "budget"
 
-    # measured round: fill every slot, time submit -> all finished, count
-    # every emitted token (includes prefill admission — the honest serving
-    # number, not just the steady-state decode loop)
-    t0 = time.time()
-    requests = [engine.submit(prompt, max_new_tokens=max_new, temperature=0.0)
-                for _ in range(n_slots)]
-    for r in requests:
-        r.result(timeout_s=600)
-    elapsed = time.time() - t0
-    counted = sum(r.generated for r in requests)
-    ttfts = sorted(r.first_token_at - r.enqueued_at for r in requests
-                   if r.first_token_at is not None)
+    # ---- L: TTFT under Poisson arrivals -----------------------------------
+    if full_run and mixed_tok_s and _left() > 120:
+        rate = 0.7 * mixed_tok_s / max_new
+        ttfts = run_phase_latency(engine, prompts, max_new, rate,
+                                  duration_s=min(25.0, _left() - 60), rng=rng)
+        p50, p99 = _percentiles(ttfts)
+        print(f"[bench] L ttft@poisson({rate:.1f} rps): p50={p50*1e3:.0f}ms "
+              f"p99={p99*1e3:.0f}ms n={len(ttfts)}", file=sys.stderr)
+        extras.update(ttft_p50_ms=round(p50 * 1e3, 1),
+                      ttft_p99_ms=round(p99 * 1e3, 1),
+                      ttft_arrival_rps=round(rate, 2))
+    elif burst_ttfts:
+        p50, p99 = _percentiles(burst_ttfts)
+        extras.update(ttft_p50_ms=round(p50 * 1e3, 1),
+                      ttft_p99_ms=round(p99 * 1e3, 1),
+                      ttft_arrival="burst")
+        print(f"[bench] L ttft@burst: p50={p50*1e3:.0f}ms p99={p99*1e3:.0f}ms",
+              file=sys.stderr)
+    else:
+        extras["ttft_skipped"] = "no samples"
 
     engine.stop()
-    tok_s = counted / elapsed
-    print(f"[bench] {counted} tokens in {elapsed:.2f}s", file=sys.stderr)
-    if ttfts:  # BASELINE.md config 4's second number: p50 TTFT <150 ms
-        print(f"[bench] ttft p50={ttfts[len(ttfts)//2]*1e3:.0f}ms "
-              f"p99={ttfts[int(len(ttfts)*0.99)]*1e3:.0f}ms", file=sys.stderr)
 
     result = {
         "metric": f"decode_tokens_per_sec_{'llama1b_bf16' if on_tpu else 'debug_cpu'}"
@@ -126,6 +235,9 @@ def main() -> None:
         "value": round(tok_s, 1),
         "unit": "tok/s",
         "vs_baseline": round(tok_s / BASELINE_TOK_S, 3),
+        "platform": platform,
+        "fallback_reason": None if on_tpu else reason,
+        "extras": extras,
     }
     print(json.dumps(result))
 

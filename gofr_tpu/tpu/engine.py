@@ -111,16 +111,22 @@ class _Slot:
         return self.request is not None
 
 
-def _pow2_split(n: int, cap: int) -> List[int]:
-    """Decompose n into descending powers of two (each <= cap) so batched
-    prefill compiles a bounded set of K variants."""
+def _pow4_split(n: int, cap: int) -> List[int]:
+    """Decompose n into descending powers of FOUR (each <= cap).
+
+    Powers of four (not two) bound the compiled prefill-program variants to
+    K in {1, 4, 16, 64} per prompt bucket — with multiple prompt-length
+    buckets the (bucket x K) compile product is the boot-time cost that
+    matters. The price is up to 2 extra dispatches per base-4 digit of the
+    wave size (42 -> [16,16,4,4,1,1] vs [32,8,2]); admission waves are
+    slot-turnover sized in steady state, so the common case is 1 dispatch."""
     out: List[int] = []
     k = 1
-    while k * 2 <= cap:
-        k *= 2
+    while k * 4 <= cap:
+        k *= 4
     while n > 0:
         while k > n:
-            k //= 2
+            k //= 4
         out.append(k)
         n -= k
     return out
@@ -269,21 +275,29 @@ class LLMEngine:
             self._thread = None
         self._drain_pending(RuntimeError("engine stopped"))
 
-    def warmup(self) -> None:
+    def warmup(self, grow: bool = True) -> None:
         """Pre-compile single-admission prefill buckets and the decode
-        program at the boot-time cache size. Programs for grown cache sizes
-        (and batched-K prefill variants) compile on first use — one ~1s
-        hiccup per power-of-two growth over the engine's lifetime.
+        program. Programs for grown cache sizes (and batched-K prefill
+        variants) compile on first use — one ~1s hiccup per power-of-two
+        growth over the engine's lifetime.
+
+        grow=True (server boot) grows the cache to cover the largest prefill
+        bucket up front so no request pays a growth copy; grow=False keeps
+        the boot-time minimum so short-context workloads keep a small
+        allocation (per-step decode cost tracks the ALLOCATED seq dim).
 
         Safe against an already-started loop: cache growth and compiles run
         under the same state lock the loop's dispatch phase takes."""
         with self._state_lock:
-            if self.prefill_buckets:
+            if grow and self.prefill_buckets:
                 self._grow_cache(max(self.prefill_buckets) + 1)
             for bucket in self.prefill_buckets:
-                self._prefill_program(bucket, 1)
-                if self.logger is not None:
-                    self.logger.debugf("warmed prefill bucket %d", bucket)
+                # a bucket is compilable once it fits the allocated cache
+                # (bucket == cache uses the full-row splice branch)
+                if bucket <= self._cache_len:
+                    self._prefill_program(bucket, 1)
+                    if self.logger is not None:
+                        self.logger.debugf("warmed prefill bucket %d", bucket)
             self._decode_program()
 
     # -- compiled programs ----------------------------------------------------
@@ -448,7 +462,7 @@ class LLMEngine:
         try:
             for bucket, group in by_bucket.items():
                 offset = 0
-                for K in _pow2_split(len(group), self.n_slots):
+                for K in _pow4_split(len(group), self.n_slots):
                     batch = group[offset:offset + K]
                     offset += K
                     slots_idx = [next(free_iter) for _ in batch]

@@ -279,13 +279,14 @@ def test_engine_pipelined_matches_synchronous():
     assert run(1, 1) == run(4, 3)
 
 
-def test_engine_pow2_split():
-    from gofr_tpu.tpu.engine import _pow2_split
+def test_engine_pow4_split():
+    from gofr_tpu.tpu.engine import _pow4_split
 
-    assert _pow2_split(11, 64) == [8, 2, 1]
-    assert _pow2_split(64, 64) == [64]
-    assert _pow2_split(5, 4) == [4, 1]
-    assert _pow2_split(1, 8) == [1]
+    assert _pow4_split(11, 64) == [4, 4, 1, 1, 1]
+    assert _pow4_split(64, 64) == [64]
+    assert _pow4_split(5, 4) == [4, 1]
+    assert _pow4_split(1, 8) == [1]
+    assert _pow4_split(128, 128) == [64, 64]
 
 
 def test_engine_stop_unblocks_active_requests():
