@@ -42,6 +42,10 @@ def build_engine(app: App) -> LLMEngine:
     app.logger.infof("initialising %s (%.2fB params)...", preset,
                      cfg.param_count() / 1e9)
     params = llama_init(cfg, seed=0)
+    # TP_SHARDS>1 serves tensor-parallel over the chip slice (BASELINE
+    # config 5: Llama-70B TP=8 on v5e-8) — same engine, sharded mesh
+    tp = app.config.get_int("TP_SHARDS", 1)
+    mesh = tpu.mesh({"tp": tp}, allow_subset=True) if tp > 1 else None
     engine = LLMEngine(
         params, cfg,
         n_slots=app.config.get_int("MAX_BATCH", 8),
@@ -51,6 +55,7 @@ def build_engine(app: App) -> LLMEngine:
         executor=Executor(tpu),
         metrics=app.container.metrics_manager,
         logger=app.logger,
+        mesh=mesh,
     )
     engine.tokenizer = tokenizer
     engine.start()

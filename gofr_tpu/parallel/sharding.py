@@ -52,6 +52,42 @@ def llama_param_specs(moe: bool = False) -> Dict[str, Any]:
     }
 
 
+def serving_param_specs() -> Dict[str, Any]:
+    """PartitionSpec pytree for the SERVING engine: tp only.
+
+    No pp axis — the stacked [L, ...] layer axis stays whole so the decode
+    lax.scan runs every layer on every tp shard (Megatron-style: per-layer
+    all-reduce rides ICI). Contiguous-block head sharding means splitting
+    the flattened H*dh / Hkv*dh projection axis over tp yields whole heads
+    per shard, matching the KV cache's Hkv shard (kv_cache_spec). tok_emb
+    is replicated (token-id gather at arbitrary ids beats a vocab-sharded
+    gather+psum for decode's tiny T); lm_head stays column-parallel.
+    """
+    layers = {
+        "wq": _P(None, None, "tp"),
+        "wk": _P(None, None, "tp"),
+        "wv": _P(None, None, "tp"),
+        "wo": _P(None, "tp", None),
+        "w_gate": _P(None, None, "tp"),
+        "w_up": _P(None, None, "tp"),
+        "w_down": _P(None, "tp", None),
+        "attn_norm": _P(None, None),
+        "ffn_norm": _P(None, None),
+    }
+    return {
+        "tok_emb": _P(None, None),
+        "layers": layers,
+        "final_norm": _P(None),
+        "lm_head": _P(None, "tp"),
+    }
+
+
+def kv_cache_spec():
+    """KV cache [L, B, S, Hkv, dh]: KV heads shard over tp, matching the
+    column split of wk/wv so each shard writes and reads only its heads."""
+    return _P(None, None, None, "tp", None)
+
+
 def batch_spec():
     """Token batches [B, T]: batch over dp, sequence over sp."""
     return _P("dp", "sp")

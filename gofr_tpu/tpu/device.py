@@ -124,11 +124,14 @@ class TPUClient:
     def platform(self) -> str:
         return self._devices[0].platform if self._devices else "none"
 
-    def mesh(self, axes: Dict[str, int]):
+    def mesh(self, axes: Dict[str, int], allow_subset: bool = False):
         """Build a jax.sharding.Mesh over the client's devices.
 
         axes: ordered {axis_name: size}; product must equal device_count
-        (pass -1 for one axis to infer it).
+        (pass -1 for one axis to infer it). allow_subset=True builds the
+        mesh over the FIRST product-many devices instead — for serving
+        configs sharded narrower than the visible slice (e.g. TP=2 on an
+        8-chip host).
         """
         import numpy as np
         from jax.sharding import Mesh
@@ -139,10 +142,12 @@ class TPUClient:
             known = int(np.prod([s for s in sizes if s != -1]))
             sizes[sizes.index(-1)] = len(self._devices) // known
         total = int(np.prod(sizes))
-        if total != len(self._devices):
+        if total != len(self._devices) and not (allow_subset
+                                                and total < len(self._devices)):
             raise ValueError(f"mesh axes {dict(zip(names, sizes))} need {total} devices, "
                              f"have {len(self._devices)}")
-        return Mesh(np.array(self._devices).reshape(sizes), tuple(names))
+        return Mesh(np.array(self._devices[:total]).reshape(sizes),
+                    tuple(names))
 
     def memory_stats(self) -> List[Dict[str, Any]]:
         out = []

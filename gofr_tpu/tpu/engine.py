@@ -111,24 +111,27 @@ class _Slot:
         return self.request is not None
 
 
-def _pow4_split(n: int, cap: int) -> List[int]:
-    """Decompose n into descending powers of FOUR (each <= cap).
+def _admission_split(n: int, cap: int) -> List[int]:
+    """Decompose an admission wave of n into descending K-sizes from
+    {cap} + powers of four <= cap.
 
-    Powers of four (not two) bound the compiled prefill-program variants to
-    K in {1, 4, 16, 64} per prompt bucket — with multiple prompt-length
-    buckets the (bucket x K) compile product is the boot-time cost that
-    matters. The price is up to 2 extra dispatches per base-4 digit of the
-    wave size (42 -> [16,16,4,4,1,1] vs [32,8,2]); admission waves are
-    slot-turnover sized in steady state, so the common case is 1 dispatch."""
-    out: List[int] = []
+    Powers of four bound the compiled prefill-program variants per prompt
+    bucket — with multiple prompt-length buckets the (bucket x K) compile
+    product is the boot-time cost that matters. cap (= n_slots) itself is
+    always a candidate so a cold full-slot burst still fuses into ONE
+    dispatch (measured better on v5e than chunked admission for both TTFT
+    and throughput). Steady-state turnover waves are small, so the common
+    case is a single small-K dispatch."""
+    candidates = {cap}
     k = 1
-    while k * 4 <= cap:
+    while k <= cap:
+        candidates.add(k)
         k *= 4
-    while n > 0:
-        while k > n:
-            k //= 4
-        out.append(k)
-        n -= k
+    out: List[int] = []
+    for k in sorted(candidates, reverse=True):
+        while n >= k:
+            out.append(k)
+            n -= k
     return out
 
 
@@ -148,7 +151,16 @@ class LLMEngine:
         metrics=None,
         logger=None,
         seed: int = 0,
+        mesh=None,
     ):
+        """mesh: optional jax.sharding.Mesh with a "tp" axis. When given, the
+        engine serves TENSOR-PARALLEL: params shard per serving_param_specs
+        (Megatron column/row split, per-layer collectives compiled by XLA
+        onto ICI), the KV cache shards its KV-head axis, and the per-slot
+        loop state replicates. The compiled programs are identical Python —
+        sharding propagates from the committed inputs (the scaling-book
+        recipe), so tp=1 and tp=N run the same code. BASELINE config 5's
+        70B TP=8 path is this engine + a tp=8 mesh."""
         import jax
         import jax.numpy as jnp
 
@@ -156,6 +168,16 @@ class LLMEngine:
 
         native.available()  # build/load the C++ helpers at boot, not in the
         # serving loop (first pad_batch call must never stall a decode step)
+        self.mesh = mesh
+        if mesh is not None:
+            from ..parallel.sharding import serving_param_specs, shard_params
+
+            tp = mesh.shape.get("tp", 1)
+            if cfg.n_kv_heads % tp or cfg.n_heads % tp:
+                raise ValueError(
+                    f"tp={tp} must divide n_kv_heads={cfg.n_kv_heads} and "
+                    f"n_heads={cfg.n_heads} (whole heads per shard)")
+            params = shard_params(params, mesh, serving_param_specs())
         self.params = params
         self.cfg = cfg
         self.n_slots = n_slots
@@ -209,6 +231,26 @@ class LLMEngine:
         self._positions = jnp.zeros((B,), dtype=jnp.int32)
         self._temps = jnp.zeros((B,), dtype=jnp.float32)
         self.rng = jax.random.PRNGKey(next(self._reset_counter))
+        if self.mesh is not None:
+            self._place_state()
+
+    def _place_state(self) -> None:
+        """Commit device state to the mesh: cache KV-heads over tp, loop
+        state replicated. Committed shardings propagate into every compiled
+        program; XLA inserts the tp collectives."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from ..parallel.sharding import kv_cache_spec
+
+        cache_s = NamedSharding(self.mesh, kv_cache_spec())
+        rep = NamedSharding(self.mesh, PartitionSpec())
+        self.k_cache = jax.device_put(self.k_cache, cache_s)
+        self.v_cache = jax.device_put(self.v_cache, cache_s)
+        self._tokens = jax.device_put(self._tokens, rep)
+        self._positions = jax.device_put(self._positions, rep)
+        self._temps = jax.device_put(self._temps, rep)
+        self.rng = jax.device_put(self.rng, rep)
 
     def _grow_cache(self, needed: int) -> None:
         """Pad the KV cache's seq dim to the next power-of-two bucket
@@ -220,6 +262,15 @@ class LLMEngine:
         pad = ((0, 0), (0, 0), (0, new_len - self._cache_len), (0, 0), (0, 0))
         self.k_cache = jnp.pad(self.k_cache, pad)
         self.v_cache = jnp.pad(self.v_cache, pad)
+        if self.mesh is not None:  # re-commit: pad must not drop the sharding
+            import jax
+            from jax.sharding import NamedSharding
+
+            from ..parallel.sharding import kv_cache_spec
+
+            cache_s = NamedSharding(self.mesh, kv_cache_spec())
+            self.k_cache = jax.device_put(self.k_cache, cache_s)
+            self.v_cache = jax.device_put(self.v_cache, cache_s)
         self._cache_len = new_len
         if self.logger is not None:
             self.logger.debugf("grew KV cache to %d", new_len)
@@ -282,15 +333,20 @@ class LLMEngine:
         growth over the engine's lifetime.
 
         grow=True (server boot) grows the cache to cover the largest prefill
-        bucket up front so no request pays a growth copy; grow=False keeps
-        the boot-time minimum so short-context workloads keep a small
-        allocation (per-step decode cost tracks the ALLOCATED seq dim).
+        bucket up front so no request pays a growth copy; grow=False grows
+        only to the smallest SERVABLE size (min bucket + 1 — dispatch always
+        needs one decode-write slot past the prompt) so short-context
+        workloads keep a small allocation (per-step decode cost tracks the
+        ALLOCATED seq dim) while the warmed programs are the ones the first
+        request actually runs.
 
         Safe against an already-started loop: cache growth and compiles run
         under the same state lock the loop's dispatch phase takes."""
         with self._state_lock:
-            if grow and self.prefill_buckets:
-                self._grow_cache(max(self.prefill_buckets) + 1)
+            if self.prefill_buckets:
+                target = (max(self.prefill_buckets) if grow
+                          else min(self.prefill_buckets))
+                self._grow_cache(target + 1)
             for bucket in self.prefill_buckets:
                 # a bucket is compilable once it fits the allocated cache
                 # (bucket == cache uses the full-row splice branch)
@@ -462,7 +518,7 @@ class LLMEngine:
         try:
             for bucket, group in by_bucket.items():
                 offset = 0
-                for K in _pow4_split(len(group), self.n_slots):
+                for K in _admission_split(len(group), self.n_slots):
                     batch = group[offset:offset + K]
                     offset += K
                     slots_idx = [next(free_iter) for _ in batch]

@@ -279,14 +279,17 @@ def test_engine_pipelined_matches_synchronous():
     assert run(1, 1) == run(4, 3)
 
 
-def test_engine_pow4_split():
-    from gofr_tpu.tpu.engine import _pow4_split
+def test_engine_admission_split():
+    from gofr_tpu.tpu.engine import _admission_split
 
-    assert _pow4_split(11, 64) == [4, 4, 1, 1, 1]
-    assert _pow4_split(64, 64) == [64]
-    assert _pow4_split(5, 4) == [4, 1]
-    assert _pow4_split(1, 8) == [1]
-    assert _pow4_split(128, 128) == [64, 64]
+    assert _admission_split(11, 64) == [4, 4, 1, 1, 1]
+    assert _admission_split(64, 64) == [64]
+    assert _admission_split(5, 4) == [4, 1]
+    assert _admission_split(1, 8) == [1]
+    # a full-slot burst fuses into ONE dispatch even off the pow4 grid
+    assert _admission_split(128, 128) == [128]
+    assert _admission_split(8, 8) == [8]
+    assert _admission_split(100, 128) == [64, 16, 16, 4]
 
 
 def test_engine_stop_unblocks_active_requests():
