@@ -1,21 +1,29 @@
 """Headline benchmark: continuous-batching serving throughput + TTFT.
 
-Run by the driver on real TPU hardware at the end of each round; prints ONE
+Run by the driver on real TPU hardware at the end of each round; prints a
 JSON line {"metric", "value", "unit", "vs_baseline", ...}.
+
+CRASH-PROOF CONTRACT (the round-2 failure was losing every phase's result
+to one late OOM): a cumulative result line is printed the MOMENT each phase
+completes, so the last JSON line on stdout is always the most complete
+measurement that actually finished. Each phase runs under its own
+try/except; an OOM degrades the config (halve slots, rebuild the engine)
+and retries once instead of erasing the record.
 
 What it measures (BASELINE.md config 4), three phases on one engine:
   T0 — round-1-comparable decode throughput: 8-token prompts, short
-    contexts, small KV allocation (the config the 4918 tok/s round-1 claim
-    was measured under). This is the PRIMARY metric for round-over-round
+    contexts, small KV allocation. PRIMARY metric for round-over-round
     continuity; vs_baseline = value / 2000 (config-4 per-chip target).
   T1 — honest serving throughput under a REALISTIC prompt mix (64-512
     token prompts, slot turnover, grown cache).
   L  — p50/p99 TTFT under a Poisson arrival process at ~70% of measured
     capacity (queue wait + prefill + pipeline sync, not a burst).
-T1/L ride in the same JSON object under "extras".
+T1/L ride in the same JSON object under "extras", plus HBM-roofline
+accounting (tok/s vs the v5e ~819 GB/s bandwidth bound).
 
-On CPU (no TPU acquired) it falls back to the debug model so the harness
-still emits a line, and reports WHY in "fallback_reason".
+Memory discipline: the engine config is pre-flighted through
+gofr_tpu.tpu.capacity.plan_capacity against the device's reported
+bytes_limit before any allocation (VERDICT r2 missing #2).
 """
 
 import json
@@ -26,12 +34,19 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 BASELINE_TOK_S = 2000.0
+V5E_HBM_GBPS = 819.0  # v5e HBM bandwidth roofline for decode
 BENCH_BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "1500"))
 _T0 = time.time()
 
 
 def _left() -> float:
     return BENCH_BUDGET_S - (time.time() - _T0)
+
+
+def _is_oom(exc: BaseException) -> bool:
+    text = f"{type(exc).__name__}: {exc}"
+    return ("RESOURCE_EXHAUSTED" in text or "Out of memory" in text
+            or "out of memory" in text)
 
 
 def _probe_once(timeout_s: float):
@@ -96,9 +111,9 @@ def _percentiles(xs):
 
 
 def run_phase_throughput(engine, prompts, max_new, rounds=1):
-    """Saturate the engine with 2x slots of mixed prompts; measure emitted
-    tokens/sec from first submit to last completion (includes prefill —
-    the honest serving number)."""
+    """Saturate the engine with mixed prompts; measure emitted tokens/sec
+    from first submit to last completion (includes prefill — the honest
+    serving number)."""
     for _ in range(rounds):  # warm: drives cache growth + compiles hot
         warm = [engine.submit(p, max_new_tokens=max_new, temperature=0.0)
                 for p in prompts]
@@ -134,6 +149,32 @@ def run_phase_latency(engine, prompts, max_new, rate_rps, duration_s, rng):
             if r.first_token_at is not None]
 
 
+class _Record:
+    """Cumulative result emitter: every update() reprints the full JSON line,
+    so a crash after phase N still leaves phase N's line as the last parsable
+    stdout record (VERDICT r2 weak #1)."""
+
+    def __init__(self, metric, platform, fallback_reason):
+        self.result = {"metric": metric, "value": 0.0, "unit": "tok/s",
+                       "vs_baseline": 0.0, "platform": platform,
+                       "fallback_reason": fallback_reason, "extras": {}}
+
+    def update(self, value=None, **extras):
+        if value is not None:
+            self.result["value"] = round(value, 1)
+            self.result["vs_baseline"] = round(value / BASELINE_TOK_S, 3)
+        self.result["extras"].update(extras)
+        print(json.dumps(self.result), flush=True)
+
+    def rename_slots(self, n_slots):
+        """Keep the metric name honest after an OOM degradation: the _bsN
+        tag must reflect the slots actually measured."""
+        import re
+
+        self.result["metric"] = re.sub(r"_bs\d+_", f"_bs{n_slots}_",
+                                       self.result["metric"])
+
+
 def main() -> None:
     import numpy as np
 
@@ -148,10 +189,17 @@ def main() -> None:
     platform = jax.devices()[0].platform
 
     from gofr_tpu.models.llama import LlamaConfig, llama_init
+    from gofr_tpu.tpu.capacity import (device_budget_bytes, kv_cache_bytes,
+                                       params_bytes)
     from gofr_tpu.tpu.engine import LLMEngine
 
+    import dataclasses
+
     if on_tpu:
-        cfg = LlamaConfig.llama1b()
+        # flash prefill: full-window Pallas kernel instead of the [T, S]
+        # score materialization (falls back to xla if the kernel won't
+        # compile on the tunneled backend — see make_engine)
+        cfg = dataclasses.replace(LlamaConfig.llama1b(), attn_impl="flash")
         n_slots, max_new, max_seq = 128, 128, 1024
         prefill_buckets = (16, 64, 128, 256, 512)
         full_run = True
@@ -161,85 +209,177 @@ def main() -> None:
         prefill_buckets = (16, 64, 128)
         full_run = False
 
+    # HBM budget: the engine pre-flights plan_capacity(budget_bytes=...)
+    # at construction and clamps (n_slots, max_seq, buckets) itself — ONE
+    # source of truth for what actually serves. The tunneled PJRT device
+    # reports no bytes_limit, so fall back to the v5e chip's 16 GiB.
+    budget = device_budget_bytes() if on_tpu else 0
+    if on_tpu and not budget:
+        budget = 16 << 30
+
     print(f"[bench] platform={platform} tpu={on_tpu} ({reason}) "
           f"model={cfg.dim}d x {cfg.n_layers}L "
-          f"({cfg.param_count()/1e9:.2f}B params) slots={n_slots}",
+          f"({cfg.param_count()/1e9:.2f}B params) slots={n_slots} "
+          f"budget={budget/2**30:.1f}GiB",
           file=sys.stderr)
 
+    record = _Record(
+        f"decode_tokens_per_sec_{'llama1b_bf16' if on_tpu else 'debug_cpu'}"
+        f"_bs{n_slots}_1chip",
+        platform, None if on_tpu else reason)
+
     rng = np.random.default_rng(0)
-    t0 = time.time()
     params = llama_init(cfg, seed=0)
-    # block/depth from a sweep on v5e: small blocks turn finished slots over
-    # faster and keep the growth margin tight; depth 2 is enough to hide
-    # dispatch latency (deeper just inflates the in-flight margin)
-    engine = LLMEngine(params, cfg, n_slots=n_slots, max_seq_len=max_seq,
-                       prefill_buckets=prefill_buckets, decode_block_size=8,
-                       pipeline_depth=2, seed=0)
-    engine.start()
-    # grow=False: T0 must run at the small boot-time allocation (the r01
-    # measurement condition); T1's warm round grows the cache on demand
-    engine.warmup(grow=False)
-    print(f"[bench] init+warmup {time.time()-t0:.1f}s", file=sys.stderr)
-    extras = {}
+
+    def make_engine(slots, seq, use_cfg):
+        # block/depth from a sweep on v5e: small blocks turn finished slots
+        # over faster; depth 2 hides dispatch latency without inflating the
+        # in-flight margin
+        eng = LLMEngine(params, use_cfg, n_slots=slots, max_seq_len=seq,
+                        prefill_buckets=tuple(b for b in prefill_buckets
+                                              if b <= seq),
+                        decode_block_size=8, pipeline_depth=2, seed=0,
+                        budget_bytes=budget or None)
+        eng.start()
+        try:
+            # grow=False: T0 must run at the small boot-time allocation (the
+            # r01 measurement condition); T1's warm round grows on demand
+            eng.warmup(grow=False)
+        except Exception:
+            # a started-but-broken engine pins its HBM buffers via the loop
+            # thread; the degrade-retry depends on them being released
+            eng.stop()
+            raise
+        return eng
+
+    t_init = time.time()
+    engine = boot_exc = None
+    try:
+        engine = make_engine(n_slots, max_seq, cfg)
+    except Exception as exc:  # noqa: BLE001 - degrade, don't die
+        print(f"[bench] boot failed ({type(exc).__name__}): {exc}",
+              file=sys.stderr)
+        if _is_oom(exc):
+            n_slots, max_seq = max(1, n_slots // 2), max(256, max_seq // 2)
+            record.rename_slots(n_slots)
+            record.update(boot_oom_degraded_to_slots=n_slots)
+        elif cfg.attn_impl == "flash":
+            # Pallas kernel failed to compile on this backend: dense prefill
+            cfg = dataclasses.replace(cfg, attn_impl="xla")
+            record.update(flash_prefill="compile failed, xla fallback")
+        else:
+            raise
+        boot_exc = exc
+    if engine is None:
+        # retry OUTSIDE the except block: exc.__traceback__ pins the failed
+        # make_engine frame (and any buffers it allocated); the reference
+        # must be dead before the halved-config retry allocates
+        del boot_exc
+        engine = make_engine(n_slots, max_seq, cfg)
+    # the engine's capacity plan is the source of truth for what serves —
+    # sync the record and local sizing to it
+    if engine.plan is not None:
+        print(f"[bench] {engine.plan.summary()}", file=sys.stderr)
+    n_slots, max_seq = engine.n_slots, engine.max_seq_len
+    record.rename_slots(engine.n_slots)
+    record.update(attn_impl=cfg.attn_impl)
+    print(f"[bench] init+warmup {time.time()-t_init:.1f}s", file=sys.stderr)
 
     # ---- T0: round-1-comparable decode throughput (short prompts) ---------
-    short_prompts = [rng.integers(1, cfg.vocab_size, size=8).tolist()
-                     for _ in range(n_slots)]
-    tok_s, tokens, elapsed, t0_ttfts = run_phase_throughput(
-        engine, short_prompts, max_new, rounds=2 if full_run else 1)
+    def phase_t0(eng):
+        short_prompts = [rng.integers(1, cfg.vocab_size, size=8).tolist()
+                         for _ in range(eng.n_slots)]
+        return run_phase_throughput(eng, short_prompts, max_new,
+                                    rounds=2 if full_run else 1)
+
+    try:
+        tok_s, tokens, elapsed, t0_ttfts = phase_t0(engine)
+    except Exception as exc:  # noqa: BLE001
+        print(f"[bench] T0 failed: {exc}", file=sys.stderr)
+        if not _is_oom(exc) and not type(exc).__name__ == "CacheLostError":
+            raise
+        engine.stop()
+        n_slots = max(1, engine.n_slots // 2)
+        engine = None  # drop the old device buffers before re-allocating
+        record.rename_slots(n_slots)
+        record.update(t0_oom_degraded_to_slots=n_slots)
+        engine = make_engine(n_slots, max_seq, cfg)
+        tok_s, tokens, elapsed, t0_ttfts = phase_t0(engine)
     print(f"[bench] T0 short-prompt decode: {tokens} tok in {elapsed:.2f}s = "
           f"{tok_s:.1f} tok/s", file=sys.stderr)
+    # analytic HBM-roofline context: weights + BOTH caches are read every
+    # decode step; use the cache length the phase actually ran at (it grows
+    # during T0 to cover prompt + max_new + pipeline margin)
+    weights = params_bytes(cfg)
+    t0_cache = kv_cache_bytes(cfg, engine.n_slots, engine._cache_len)
+    roofline_tok_s = (V5E_HBM_GBPS * 1e9 * engine.n_slots
+                      / (weights + t0_cache)) if on_tpu else 0.0
+    record.update(value=tok_s,
+                  t0_elapsed_s=round(elapsed, 2),
+                  slots=engine.n_slots,
+                  **({"roofline_tok_s": round(roofline_tok_s, 1),
+                      "model_gib": round(weights / 2**30, 2),
+                      "t0_cache_len": engine._cache_len,
+                      "roofline_frac": round(tok_s / roofline_tok_s, 3)}
+                     if roofline_tok_s else {}))
 
     # ---- T1: honest mixed-prompt serving throughput -----------------------
-    prompts = _prompt_mix(rng, 2 * n_slots, cfg.vocab_size,
+    prompts = _prompt_mix(rng, 2 * engine.n_slots, cfg.vocab_size,
                           engine.admission_limit)
     mean_len = sum(len(p) for p in prompts) / len(prompts)
+    mixed_tok_s, burst_ttfts = 0.0, t0_ttfts
     if _left() > 300 or not full_run:
-        mixed_tok_s, tokens, elapsed, burst_ttfts = run_phase_throughput(
-            engine, prompts, max_new, rounds=2 if full_run else 1)
-        print(f"[bench] T1 mixed-prompt serve: {tokens} tok in {elapsed:.2f}s "
-              f"= {mixed_tok_s:.1f} tok/s (mean prompt {mean_len:.0f})",
-              file=sys.stderr)
-        extras.update(mixed_prompt_tok_s=round(mixed_tok_s, 1),
-                      mean_prompt_len=round(mean_len, 1))
+        try:
+            mixed_tok_s, tokens, elapsed, burst_ttfts = run_phase_throughput(
+                engine, prompts, max_new, rounds=2 if full_run else 1)
+            print(f"[bench] T1 mixed-prompt serve: {tokens} tok in {elapsed:.2f}s "
+                  f"= {mixed_tok_s:.1f} tok/s (mean prompt {mean_len:.0f})",
+                  file=sys.stderr)
+            record.update(mixed_prompt_tok_s=round(mixed_tok_s, 1),
+                          mean_prompt_len=round(mean_len, 1))
+        except Exception as exc:  # noqa: BLE001 - keep T0's record
+            print(f"[bench] T1 failed (T0 result preserved): {exc}",
+                  file=sys.stderr)
+            record.update(t1_error=f"{type(exc).__name__}: {exc}"[:200])
+            try:
+                engine.stop()
+            except Exception:  # noqa: BLE001
+                pass
+            engine = None
     else:
-        mixed_tok_s, burst_ttfts = 0.0, t0_ttfts  # fall back to T0's TTFTs
-        extras["mixed_prompt_skipped"] = "budget"
+        record.update(mixed_prompt_skipped="budget")
 
     # ---- L: TTFT under Poisson arrivals -----------------------------------
-    if full_run and mixed_tok_s and _left() > 120:
-        rate = 0.7 * mixed_tok_s / max_new
-        ttfts = run_phase_latency(engine, prompts, max_new, rate,
-                                  duration_s=min(25.0, _left() - 60), rng=rng)
-        p50, p99 = _percentiles(ttfts)
-        print(f"[bench] L ttft@poisson({rate:.1f} rps): p50={p50*1e3:.0f}ms "
-              f"p99={p99*1e3:.0f}ms n={len(ttfts)}", file=sys.stderr)
-        extras.update(ttft_p50_ms=round(p50 * 1e3, 1),
-                      ttft_p99_ms=round(p99 * 1e3, 1),
-                      ttft_arrival_rps=round(rate, 2))
-    elif burst_ttfts:
-        p50, p99 = _percentiles(burst_ttfts)
-        extras.update(ttft_p50_ms=round(p50 * 1e3, 1),
-                      ttft_p99_ms=round(p99 * 1e3, 1),
-                      ttft_arrival="burst")
-        print(f"[bench] L ttft@burst: p50={p50*1e3:.0f}ms p99={p99*1e3:.0f}ms",
+    try:
+        if engine is not None and full_run and mixed_tok_s and _left() > 120:
+            rate = 0.7 * mixed_tok_s / max_new
+            ttfts = run_phase_latency(engine, prompts, max_new, rate,
+                                      duration_s=min(25.0, _left() - 60), rng=rng)
+            p50, p99 = _percentiles(ttfts)
+            print(f"[bench] L ttft@poisson({rate:.1f} rps): p50={p50*1e3:.0f}ms "
+                  f"p99={p99*1e3:.0f}ms n={len(ttfts)}", file=sys.stderr)
+            record.update(ttft_p50_ms=round(p50 * 1e3, 1),
+                          ttft_p99_ms=round(p99 * 1e3, 1),
+                          ttft_arrival_rps=round(rate, 2))
+        elif burst_ttfts:
+            p50, p99 = _percentiles(burst_ttfts)
+            record.update(ttft_p50_ms=round(p50 * 1e3, 1),
+                          ttft_p99_ms=round(p99 * 1e3, 1),
+                          ttft_arrival="burst")
+            print(f"[bench] L ttft@burst: p50={p50*1e3:.0f}ms p99={p99*1e3:.0f}ms",
+                  file=sys.stderr)
+        else:
+            record.update(ttft_skipped="no samples")
+    except Exception as exc:  # noqa: BLE001 - keep earlier phases' record
+        print(f"[bench] L failed (earlier results preserved): {exc}",
               file=sys.stderr)
-    else:
-        extras["ttft_skipped"] = "no samples"
+        record.update(l_error=f"{type(exc).__name__}: {exc}"[:200])
 
-    engine.stop()
-
-    result = {
-        "metric": f"decode_tokens_per_sec_{'llama1b_bf16' if on_tpu else 'debug_cpu'}"
-                  f"_bs{n_slots}_1chip",
-        "value": round(tok_s, 1),
-        "unit": "tok/s",
-        "vs_baseline": round(tok_s / BASELINE_TOK_S, 3),
-        "platform": platform,
-        "fallback_reason": None if on_tpu else reason,
-        "extras": extras,
-    }
-    print(json.dumps(result))
+    if engine is not None:
+        try:
+            engine.stop()
+        except Exception:  # noqa: BLE001
+            pass
 
 
 if __name__ == "__main__":

@@ -109,6 +109,17 @@ class Histogram(_Instrument):
         self.buckets = sorted(buckets)
 
     def record(self, value: float, **labels: str) -> None:
+        self.record_n(value, 1, **labels)
+
+    def record_n(self, value: float, n: int, **labels: str) -> None:
+        """Record `n` identical observations in one lock acquisition.
+
+        The serving hot loop emits one TPOT sample per generated token; at
+        thousands of tokens/sec the per-call dict lookup + lock dominates —
+        a decode block's tokens all share one measured step time, so they
+        batch losslessly."""
+        if n <= 0:
+            return
         key = _label_key(labels)
         with self.lock:
             entry = self.series.get(key)
@@ -116,9 +127,9 @@ class Histogram(_Instrument):
                 entry = {"counts": [0] * (len(self.buckets) + 1), "sum": 0.0, "count": 0}
                 self.series[key] = entry
             idx = bisect.bisect_left(self.buckets, value)
-            entry["counts"][idx] += 1  # type: ignore[index]
-            entry["sum"] += value  # type: ignore[operator]
-            entry["count"] += 1  # type: ignore[operator]
+            entry["counts"][idx] += n  # type: ignore[index]
+            entry["sum"] += value * n  # type: ignore[operator]
+            entry["count"] += n  # type: ignore[operator]
 
     def percentile(self, q: float, **labels: str) -> float:
         """Approximate percentile from bucket midpoints (for tests/health, not SLO math)."""
@@ -205,6 +216,10 @@ class Manager:
 
     def record_histogram(self, name: str, value: float, **labels: str) -> None:
         self._get(name, Histogram).record(value, **labels)  # type: ignore[attr-defined]
+
+    def record_histogram_n(self, name: str, value: float, n: int,
+                           **labels: str) -> None:
+        self._get(name, Histogram).record_n(value, n, **labels)  # type: ignore[attr-defined]
 
     # -- introspection -------------------------------------------------------
     def get(self, name: str) -> Optional[_Instrument]:

@@ -1,0 +1,205 @@
+"""Paged attention for TPU decode: block-table indirection via scalar prefetch.
+
+Dense serving caches allocate [B, S_max] for every slot, so one long context
+inflates every slot's footprint and per-step read cost (VERDICT r2 missing
+#4; SURVEY.md §5 long-context row: "paged or ring-buffer KV cache in HBM").
+Paging fixes both: K/V live in a fixed pool of fixed-size pages
+[P, Hkv, dh, page_size] (S-minor tile-aligned layout, see
+models/llama.init_kv_cache) and each slot owns just the pages its context
+needs, mapped by a block table [B, NP] of page indices.
+
+The TPU-native read is a Pallas kernel with SCALAR PREFETCH: the block
+table and per-slot lengths ride in SMEM ahead of the grid walk, and the
+K/V BlockSpec index_map reads table[b, p] to choose WHICH page the next
+grid step DMAs from HBM — hardware-paced gather with no materialized
+gathered cache (an XLA gather would copy the whole live cache every step).
+Online softmax (m, l, acc) carries in VMEM scratch across the page axis,
+exactly like ops/flash_attention's streaming kernel.
+
+Grid: (B, Hkv, NP) with NP innermost so the softmax carry is per-(b, h).
+Pages past a slot's live length are skipped with pl.when (their table
+entries point at page 0; the fetch happens, the compute doesn't).
+
+The XLA `paged_attention_reference` (gather-based) is the numerics oracle
+and the CPU fallback.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+DEFAULT_MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+
+def paged_attention_reference(q, k_pool, v_pool, table, lengths):
+    """Gather-based oracle. q: [B, H, dh]; pools: [P, Hkv, dh, ps];
+    table: [B, NP] int32 page ids; lengths: [B] live tokens per slot
+    (including the current token). Returns [B, H, dh] in q.dtype."""
+    B, H, dh = q.shape
+    P, Hkv, _, ps = k_pool.shape
+    NP = table.shape[1]
+    G = H // Hkv
+
+    k = k_pool[table]                     # [B, NP, Hkv, dh, ps]
+    v = v_pool[table]
+    k = jnp.moveaxis(k, 1, 3).reshape(B, Hkv, dh, NP * ps)
+    v = jnp.moveaxis(v, 1, 3).reshape(B, Hkv, dh, NP * ps)
+
+    qg = q.reshape(B, Hkv, G, dh).astype(jnp.float32)
+    s = jnp.einsum("bhgd,bhds->bhgs", qg, k.astype(jnp.float32)) / math.sqrt(dh)
+    pos = jnp.arange(NP * ps)[None, :]                    # [1, S]
+    s = jnp.where((pos < lengths[:, None])[:, None, None, :], s,
+                  DEFAULT_MASK_VALUE)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhgs,bhds->bhgd", p, v.astype(jnp.float32))
+    return out.reshape(B, H, dh).astype(q.dtype)
+
+
+def _paged_kernel(table_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+                  m_scr, l_scr, acc_scr, *, page_size: int, scale: float):
+    """One (b, h, p) grid step: fold page p into the (b, h) online softmax."""
+    from jax.experimental import pallas as pl
+
+    b = pl.program_id(0)
+    p = pl.program_id(2)
+    n_pages = pl.num_programs(2)
+    length = len_ref[b]
+
+    @pl.when(p == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, DEFAULT_MASK_VALUE)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    @pl.when(p * page_size < length)
+    def _compute():
+        q = q_ref[0, 0]                                   # [G, dh]
+        k = k_ref[0, 0]                                   # [dh, ps]
+        v = v_ref[0, 0]
+        s = jax.lax.dot_general(q, k, (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        kv_pos = p * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        s = jnp.where(kv_pos < length, s, DEFAULT_MASK_VALUE)
+        m_prev = m_scr[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        pr = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        m_scr[:] = m_new
+        l_scr[:] = l_scr[:] * alpha + jnp.sum(pr, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(pr.astype(v.dtype), v,
+                                 (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        acc_scr[:] = acc_scr[:] * alpha + pv
+
+    @pl.when(p == n_pages - 1)
+    def _finalize():
+        o_ref[0, 0] = (acc_scr[:] / jnp.maximum(l_scr[:], 1e-30)
+                       ).astype(o_ref.dtype)
+
+
+def paged_attention(q, k_pool, v_pool, table, lengths, *, interpret=None):
+    """Paged decode attention. q: [B, H, dh]; pools: [P, Hkv, dh, ps];
+    table: [B, NP] int32; lengths: [B] int32. Returns [B, H, dh].
+
+    Dead table entries (p*ps >= lengths[b]) must hold a VALID page id
+    (0 is fine): their fetch still happens, their compute is skipped.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, H, dh = q.shape
+    P, Hkv, _, ps = k_pool.shape
+    NP = table.shape[1]
+    G = H // Hkv
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    qg = q.reshape(B, Hkv, G, dh)
+    kernel = functools.partial(_paged_kernel, page_size=ps,
+                               scale=1.0 / math.sqrt(dh))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,  # table, lengths
+        grid=(B, Hkv, NP),
+        in_specs=[
+            pl.BlockSpec((1, 1, G, dh),
+                         lambda b, h, p, table, lens: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, dh, ps),
+                         lambda b, h, p, table, lens: (table[b, p], h, 0, 0)),
+            pl.BlockSpec((1, 1, dh, ps),
+                         lambda b, h, p, table, lens: (table[b, p], h, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, G, dh),
+                               lambda b, h, p, table, lens: (b, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((G, 1), jnp.float32),
+            pltpu.VMEM((G, 1), jnp.float32),
+            pltpu.VMEM((G, dh), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, Hkv, G, dh), q.dtype),
+        interpret=interpret,
+    )(table, lengths, qg, k_pool, v_pool)
+    return out.reshape(B, H, dh)
+
+
+def paged_write_decode(k_pool, v_pool, k, v, table, positions):
+    """Scatter one decode step's K/V into the pool.
+
+    k/v: [B, Hkv, dh] new entries; table: [B, NP]; positions: [B] absolute
+    write positions. Returns updated (k_pool, v_pool).
+    """
+    B = k.shape[0]
+    ps = k_pool.shape[-1]
+    page_ids = table[jnp.arange(B), positions // ps]       # [B]
+    offsets = positions % ps                               # [B]
+    # advanced indices on dims 0 and 3 -> value shape [B, Hkv, dh]
+    k_pool = k_pool.at[page_ids, :, :, offsets].set(k)
+    v_pool = v_pool.at[page_ids, :, :, offsets].set(v)
+    return k_pool, v_pool
+
+
+def paged_write_prefill_stacked(k_pool, v_pool, tmp_k, tmp_v, table, lengths):
+    """Scatter a prefill window's K/V into the stacked page pool.
+
+    k/v_pool: [L, P, Hkv, dh, ps]; tmp_k/v: [L, K, Hkv, dh, T] fresh window
+    entries at positions [0..T) (the serving prefill's tmp-cache layout);
+    table: [K, NP]; lengths: [K] true prompt lengths — positions >= length
+    scatter into the reserved GARBAGE page (pool page 0, the PageAllocator
+    invariant) so pad junk never lands in a live page.
+    Returns updated (k_pool, v_pool).
+    """
+    _, P, _, _, ps = k_pool.shape
+    K, T = table.shape[0], tmp_k.shape[-1]
+    pos = jnp.arange(T, dtype=jnp.int32)[None, :]          # [1, T]
+    page_slot = jnp.broadcast_to(pos // ps, (K, T))
+    page_ids = jnp.take_along_axis(table, page_slot, axis=1)  # [K, T]
+    page_ids = jnp.where(pos < lengths[:, None], page_ids, jnp.int32(0))
+    offsets = jnp.broadcast_to(pos % ps, (K, T))
+    # advanced indices on pool dims 1 and 4 (non-adjacent -> result dims
+    # lead) -> value shape [K, T, L, Hkv, dh]
+    val_k = tmp_k.transpose(1, 4, 0, 2, 3)
+    val_v = tmp_v.transpose(1, 4, 0, 2, 3)
+    k_pool = k_pool.at[:, page_ids, :, :, offsets].set(val_k)
+    v_pool = v_pool.at[:, page_ids, :, :, offsets].set(val_v)
+    return k_pool, v_pool
+
+
+def paged_write_prefill(k_pool, v_pool, k, v, table, lengths):
+    """Single-layer convenience over paged_write_prefill_stacked.
+
+    k/v_pool: [P, Hkv, dh, ps]; k/v: [K, T, Hkv, dh] fresh entries at
+    positions [0..T). Returns updated (k_pool, v_pool).
+    """
+    kp, vp = paged_write_prefill_stacked(
+        k_pool[None], v_pool[None],
+        k.transpose(0, 2, 3, 1)[None], v.transpose(0, 2, 3, 1)[None],
+        table, lengths)
+    return kp[0], vp[0]

@@ -83,9 +83,9 @@ def serving_param_specs() -> Dict[str, Any]:
 
 
 def kv_cache_spec():
-    """KV cache [L, B, S, Hkv, dh]: KV heads shard over tp, matching the
+    """KV cache [L, B, Hkv, dh, S]: KV heads shard over tp, matching the
     column split of wk/wv so each shard writes and reads only its heads."""
-    return _P(None, None, None, "tp", None)
+    return _P(None, None, "tp", None, None)
 
 
 def batch_spec():

@@ -1,0 +1,178 @@
+"""HBM capacity planner: fit the serving config to the device budget.
+
+The reference never plans memory — a Go microservice trusts the heap. A
+TPU serving engine cannot: params + KV caches + growth transients + prefill
+temporaries must fit a fixed HBM budget (16 GB on v5e) or the program dies
+with RESOURCE_EXHAUSTED mid-serve (the round-2 bench failure mode). This
+module is the fit calculation the engine runs at construction, the analog of
+the reference validating its config before boot (SURVEY.md §5 failure row;
+§7 hard parts "KV-cache paging/eviction in HBM").
+
+All sizes are computed from the model config analytically — no device
+allocation happens here, so the planner is unit-testable with a fake budget.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence, Tuple
+
+_DTYPE_BYTES = {"bfloat16": 2, "float16": 2, "float32": 4}
+
+
+def _dtype_bytes(name: str) -> int:
+    return _DTYPE_BYTES.get(name, 4)
+
+
+@dataclasses.dataclass(frozen=True)
+class CapacityPlan:
+    """The fit decision for one serving config on one device budget."""
+
+    n_slots: int
+    max_seq_len: int
+    prefill_buckets: Tuple[int, ...]
+    budget_bytes: int
+    params_bytes: int
+    cache_bytes_max: int        # both caches at the planned max_seq_len
+    # decode-program transient: the multi-step decode scan carries both
+    # caches through a while loop, and XLA ping-pong-buffers the carried
+    # updates — one extra cache-sized allocation pair was observed in the
+    # round-2 OOM dump ("AllocateBuffer" temps). Dominates the one-off
+    # grow-copy transient, so it is THE dense-cache transient budget.
+    growth_transient_bytes: int
+    prefill_temp_bytes: int      # worst fused-admission temporaries
+    fits: bool
+    clamped: bool                # True if the requested config was shrunk
+
+    @property
+    def peak_bytes(self) -> int:
+        """Worst simultaneous residency the plan accounts for."""
+        return (self.params_bytes + self.cache_bytes_max
+                + max(self.growth_transient_bytes, self.prefill_temp_bytes))
+
+    def summary(self) -> str:
+        gb = 1 << 30
+        return (f"capacity plan: slots={self.n_slots} max_seq={self.max_seq_len} "
+                f"params={self.params_bytes / gb:.2f}GiB "
+                f"kv={self.cache_bytes_max / gb:.2f}GiB "
+                f"transient={max(self.growth_transient_bytes, self.prefill_temp_bytes) / gb:.2f}GiB "
+                f"peak={self.peak_bytes / gb:.2f}GiB "
+                f"budget={self.budget_bytes / gb:.2f}GiB "
+                f"fits={self.fits} clamped={self.clamped}")
+
+
+def kv_cache_bytes(cfg, n_slots: int, seq_len: int,
+                   dtype: Optional[str] = None) -> int:
+    """Both (k, v) caches: 2 * [L, B, Hkv, dh, S] in the cache dtype.
+
+    Exact HBM bytes: the S-minor layout is tile-aligned on TPU (no padding
+    expansion — see init_kv_cache), so element count × itemsize is the
+    physical footprint."""
+    per = (cfg.n_layers * n_slots * seq_len * cfg.n_kv_heads * cfg.head_dim
+           * _dtype_bytes(dtype or cfg.dtype))
+    return 2 * per
+
+
+def params_bytes(cfg) -> int:
+    return cfg.param_count() * _dtype_bytes(cfg.dtype)
+
+
+def prefill_temp_bytes(cfg, k_max: int, bucket_max: int) -> int:
+    """Worst-case fused-admission temporaries for a [K, bucket] prefill.
+
+    Dominant terms: the tmp k/v caches (2 * [L, K, bucket, Hkv, dh]) the
+    prefill writes before splicing, plus per-layer activations (~4 live
+    [K, bucket, max(D, F)] tensors inside the scanned layer body — XLA keeps
+    a small constant number live, not n_layers). The lm_head buffer is gone:
+    prefill projects only [K, D] last-position rows (llama_prefill_last).
+    """
+    dt = _dtype_bytes(cfg.dtype)
+    tmp_kv = 2 * (cfg.n_layers * k_max * bucket_max * cfg.n_kv_heads
+                  * cfg.head_dim * dt)
+    acts = 4 * k_max * bucket_max * max(cfg.dim, cfg.ffn_dim) * dt
+    return tmp_kv + acts
+
+
+def plan_capacity(cfg, n_slots: int, max_seq_len: int,
+                  budget_bytes: int,
+                  prefill_buckets: Sequence[int] = (),
+                  safety_frac: float = 0.92,
+                  paged: bool = False,
+                  clamp: bool = True,
+                  min_slots: int = 1,
+                  min_seq: int = 128) -> CapacityPlan:
+    """Compute the fit; optionally shrink (n_slots, max_seq_len) until it fits.
+
+    budget_bytes: the device's bytes_limit (TPUClient.memory_stats()). A
+    safety fraction keeps headroom for XLA scratch + fragmentation.
+    paged=True drops the growth transient (the paged cache never copies the
+    world) — the pool is allocated once at its planned size.
+
+    Clamping halves whichever of (max_seq_len, n_slots) currently costs more
+    cache bytes, so a long-context config sheds sequence first and a
+    wide-batch config sheds slots first. Raises ValueError if even the
+    minimum config cannot fit (serving would be impossible, matching the
+    reference's fail-fast on unusable config).
+    """
+    if budget_bytes <= 0:
+        # CPU/unknown backends report no limit: trust the caller's config
+        buckets = tuple(b for b in prefill_buckets if b <= max_seq_len)
+        return CapacityPlan(n_slots, max_seq_len, buckets, 0,
+                            params_bytes(cfg), kv_cache_bytes(cfg, n_slots, max_seq_len),
+                            0, 0, fits=True, clamped=False)
+
+    p_bytes = params_bytes(cfg)
+    usable = int(budget_bytes * safety_frac)
+    requested = (n_slots, max_seq_len)
+
+    def peak(slots: int, seq: int) -> Tuple[int, int, int]:
+        cache = kv_cache_bytes(cfg, slots, seq)
+        # dense decode ping-pongs the scanned cache carries (one extra
+        # cache-sized pair); this also covers the smaller one-off grow copy.
+        # the paged pool is never carried whole, so it has no such transient
+        transient = 0 if paged else cache
+        bucket_max = max((b for b in prefill_buckets if b <= seq), default=0)
+        ptmp = prefill_temp_bytes(cfg, slots, bucket_max) if bucket_max else 0
+        return cache, transient, ptmp
+
+    while True:
+        cache, transient, ptmp = peak(n_slots, max_seq_len)
+        total = p_bytes + cache + max(transient, ptmp)
+        if total <= usable:
+            break
+        if not clamp:
+            buckets = tuple(b for b in prefill_buckets if b <= max_seq_len)
+            return CapacityPlan(n_slots, max_seq_len, buckets, budget_bytes,
+                                p_bytes, cache, transient, ptmp,
+                                fits=False, clamped=False)
+        if n_slots <= min_slots and max_seq_len <= min_seq:
+            raise ValueError(
+                f"model cannot serve within budget: params {p_bytes >> 20} MiB "
+                f"+ minimum cache {cache >> 20} MiB exceed "
+                f"{usable >> 20} MiB usable of {budget_bytes >> 20} MiB")
+        # shed whichever axis is currently more expensive, respecting floors
+        if (max_seq_len > min_seq
+                and (max_seq_len >= 2 * min_seq and max_seq_len * min_slots
+                     >= n_slots * min_seq or n_slots <= min_slots)):
+            max_seq_len = max(min_seq, max_seq_len // 2)
+        else:
+            n_slots = max(min_slots, n_slots // 2)
+
+    buckets = tuple(b for b in prefill_buckets if b <= max_seq_len)
+    return CapacityPlan(n_slots, max_seq_len, buckets, budget_bytes,
+                        p_bytes, cache, transient, ptmp,
+                        fits=True, clamped=(n_slots, max_seq_len) != requested)
+
+
+def device_budget_bytes(tpu_client=None) -> int:
+    """The first device's bytes_limit, or 0 when unknown (CPU backends)."""
+    if tpu_client is not None:
+        stats = tpu_client.memory_stats()
+        return int(stats[0]["bytes_limit"]) if stats else 0
+    try:
+        import jax
+
+        stats = jax.devices()[0].memory_stats() or {}
+        return int(stats.get("bytes_limit", 0))
+    except Exception:  # noqa: BLE001
+        return 0

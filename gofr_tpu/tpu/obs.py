@@ -31,3 +31,11 @@ class MetricsHook:
                 self.metrics.record_histogram(name, value, **labels)
             except Exception:  # noqa: BLE001
                 pass
+
+    def hist_n(self, name: str, value, n: int, **labels) -> None:
+        """n identical observations in one call (hot-loop batching)."""
+        if self.metrics is not None:
+            try:
+                self.metrics.record_histogram_n(name, value, n, **labels)
+            except Exception:  # noqa: BLE001
+                pass

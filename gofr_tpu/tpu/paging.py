@@ -1,0 +1,345 @@
+"""Paged KV serving: page allocator + block-table engine.
+
+Dense serving (engine.LLMEngine) gives every slot the same [S] cache rows,
+so one long context inflates every slot's HBM footprint and per-step read
+cost, and growth copies the world. The paged engine fixes this the way the
+TPU wants it fixed (SURVEY.md §5 long-context row; VERDICT r2 missing #4):
+
+  - K/V live in a FIXED pool [L, P, Hkv, dh, page_size] allocated once at
+    boot — no growth copies, no per-slot max_seq reservation
+  - a slot owns ceil((prompt + max_new) / page_size) pages, mapped by a
+    block table; pages return to the free list the moment the slot finishes
+  - admission defers (FIFO) when the free list cannot cover a request, so
+    the pool is an explicit budget instead of an OOM surprise
+  - decode reads ride the scalar-prefetch Pallas kernel
+    (ops/paged_attention): the block table rides in SMEM and picks which
+    HBM page each grid step DMAs — per-step traffic tracks live pages, and
+    the pallas operands keep the pool in its unpadded S-minor layout
+  - the block table is host-owned (plain numpy) and uploaded per dispatch,
+    bucketed to power-of-two widths to bound compiled decode variants
+
+The allocator is the HBM analog of the reference's connection-pool
+bookkeeping (sql.go pool stats): a resource ledger the serving loop
+consults before committing work.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..models.llama import LlamaConfig, llama_decode_step_paged, llama_prefill_last
+from ..ops.paged_attention import paged_write_prefill_stacked
+from .engine import (CacheLostError, GenerationRequest, LLMEngine,
+                     _pin_standard_layout)
+
+
+class PageAllocator:
+    """Free-list page ledger. Page ids run [0, n_pages); page 0 is reserved
+    as the GARBAGE page and never handed out. Garbage-at-zero is a safety
+    invariant, not a convenience: zero-filled block-table entries (inactive
+    slot rows, dead columns) then point at garbage BY CONSTRUCTION, so a
+    lock-step decode's junk writes for inactive/overrun rows can never land
+    in a live page."""
+
+    def __init__(self, n_pages: int, page_size: int):
+        if n_pages < 2:
+            raise ValueError("need at least 2 pages (1 usable + garbage)")
+        self.n_pages = n_pages
+        self.page_size = page_size
+        self.garbage_page = 0
+        self._free: List[int] = list(range(1, n_pages))
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_pages(self) -> int:
+        return (self.n_pages - 1) - len(self._free)
+
+    def pages_for(self, tokens: int) -> int:
+        return max(1, math.ceil(tokens / self.page_size))
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """n pages or None (never partial)."""
+        if n > len(self._free):
+            return None
+        taken, self._free = self._free[:n], self._free[n:]
+        return taken
+
+    def release(self, pages: Sequence[int]) -> None:
+        self._free.extend(pages)
+
+
+def _pow2_at_least(n: int) -> int:
+    return 1 << max(0, (n - 1).bit_length())
+
+
+class PagedLLMEngine(LLMEngine):
+    """Continuous-batching engine over a paged KV pool.
+
+    Inherits the whole serving loop (admission fusion, pipelined dispatch,
+    demux, failure handling) from LLMEngine; overrides the device-state,
+    prefill, and decode layers. page budget: n_pages * page_size tokens
+    TOTAL across slots — callers size it from the capacity plan
+    (plan_capacity(..., paged=True)) instead of n_slots * max_seq.
+    """
+
+    def __init__(self, params, cfg: LlamaConfig, *, page_size: int = 128,
+                 n_pages: Optional[int] = None, **kw):
+        self.page_size = page_size
+        self._requested_pages = n_pages
+        # set pre-super: _init_device_state runs inside super().__init__
+        super().__init__(params, cfg, **kw)
+
+    # -- device state ---------------------------------------------------------
+    def _init_device_state(self) -> None:
+        import jax
+
+        jnp = self._jnp
+        ps = self.page_size
+        # default pool: full dense equivalent (every slot can reach
+        # max_seq_len); real deployments pass the planned smaller n_pages
+        n_pages = self._requested_pages or (
+            self.n_slots * math.ceil(self.max_seq_len / ps) + 1)
+        self.allocator = PageAllocator(n_pages, ps)
+        self._reservations: Dict[int, List[int]] = {}
+        self._cache_len = self.max_seq_len  # admission_limit compatibility
+        L, Hkv, dh = self.cfg.n_layers, self.cfg.n_kv_heads, self.cfg.head_dim
+        dt = {"bfloat16": jnp.bfloat16, "float32": jnp.float32,
+              "float16": jnp.float16}[self.cfg.dtype]
+        self.k_cache = jnp.zeros((L, n_pages, Hkv, dh, ps), dtype=dt)
+        self.v_cache = jnp.zeros_like(self.k_cache)
+        B = self.n_slots
+        self._tokens = jnp.zeros((B,), dtype=jnp.int32)
+        self._positions = jnp.zeros((B,), dtype=jnp.int32)
+        self._temps = jnp.zeros((B,), dtype=jnp.float32)
+        self.rng = jax.random.PRNGKey(next(self._reset_counter))
+        if self.mesh is not None:
+            self._place_state()
+
+    def pool_bytes(self) -> int:
+        return 2 * self.k_cache.size * self.k_cache.dtype.itemsize
+
+    def _grow_cache(self, needed: int) -> None:
+        """Paged pool never grows — capacity is the page budget."""
+
+    def _decode_need(self) -> int:
+        return 0
+
+    # -- admission: page reservation ------------------------------------------
+    def submit(self, prompt_tokens, max_new_tokens: int = 128,
+               temperature: float = 0.0, stop_tokens=None) -> GenerationRequest:
+        """Reject requests whose reservation could NEVER fit the pool:
+        deferring them would head-of-line-block every later request behind
+        an allocation that cannot succeed."""
+        total = min(len(prompt_tokens) + max_new_tokens, self.max_seq_len)
+        need = self.allocator.pages_for(total)
+        usable = self.allocator.n_pages - 1
+        if need > usable:
+            raise ValueError(
+                f"request needs {need} pages ({total} tokens at page_size="
+                f"{self.allocator.page_size}) but the pool has only {usable} "
+                f"usable pages; shrink max_new_tokens or grow n_pages")
+        return super().submit(prompt_tokens, max_new_tokens, temperature,
+                              stop_tokens)
+
+    def _request_pages(self, request: GenerationRequest) -> int:
+        total = min(len(request.prompt_tokens) + request.max_new_tokens,
+                    self.max_seq_len)
+        return self.allocator.pages_for(total)
+
+    def _admission_ready(self, request: GenerationRequest) -> bool:
+        if request.id in self._reservations:
+            return True
+        pages = self.allocator.alloc(self._request_pages(request))
+        if pages is None:
+            self._obs.counter("app_tpu_page_waits_total")
+            return False
+        self._reservations[request.id] = pages
+        return True
+
+    def _abort_admission(self, request: GenerationRequest) -> None:
+        pages = self._reservations.pop(request.id, None)
+        if pages is not None:
+            self.allocator.release(pages)
+
+    def _finish_slot(self, slot) -> None:
+        if slot.pages is not None:
+            self.allocator.release(slot.pages)
+            slot.pages = None
+        super()._finish_slot(slot)
+        self._obs.gauge("app_tpu_pages_used", self.allocator.used_pages)
+
+    # -- programs -------------------------------------------------------------
+    def warmup(self, grow: bool = True) -> None:
+        with self._state_lock:
+            for bucket in self.prefill_buckets:
+                self._prefill_program(bucket, 1)
+            # warm the table widths the first admissions will actually hit:
+            # dispatch uses pow2(widest_pages + 1), so NP=1 never occurs
+            warm_widths = set()
+            for bucket in self.prefill_buckets[:1] or (self.page_size,):
+                pages = self.allocator.pages_for(
+                    min(bucket + 128, self.max_seq_len))
+                warm_widths.add(_pow2_at_least(pages + 1))
+            for width in sorted(warm_widths):
+                self._decode_program_paged(width)
+
+    def _prefill_fn(self, bucket: int, K: int):
+        cfg = self.cfg
+        jnp = self._jnp
+        top_k = self.top_k
+        from .sampling import sample_tokens
+
+        def prefill(params, k_pool, v_pool, ptokens, ptable, slots, lengths,
+                    tokens, positions, temps, new_temps, rng):
+            """Fused K-way paged admission: forward the [K, bucket] window
+            (flash or dense attention over the fresh window), scatter the
+            per-layer K/V into the slots' pages, sample first tokens, and
+            splice loop state. ptable: [K, ceil(bucket/ps)] page ids."""
+            L, P, Hkv, dh, _ = k_pool.shape
+            k_pool, v_pool = _pin_standard_layout(k_pool, v_pool)
+            tmp_k = jnp.zeros((L, K, Hkv, dh, bucket), dtype=k_pool.dtype)
+            tmp_v = jnp.zeros_like(tmp_k)
+            pos_grid = jnp.broadcast_to(
+                jnp.arange(bucket, dtype=jnp.int32)[None, :], (K, bucket))
+            last, tmp_k, tmp_v = llama_prefill_last(
+                params, cfg, ptokens, pos_grid, lengths, tmp_k, tmp_v)
+            # scatter the window into pages: token t of row k goes to
+            # (ptable[k, t // ps], t % ps); pad junk past lengths[k] is
+            # redirected to the garbage page so live pages stay clean
+            k_pool, v_pool = paged_write_prefill_stacked(
+                k_pool, v_pool, tmp_k, tmp_v, ptable, lengths)
+            first, rng = sample_tokens(last, rng, new_temps, top_k=top_k)
+            tokens = tokens.at[slots].set(first)
+            positions = positions.at[slots].set(lengths)
+            temps = temps.at[slots].set(new_temps)
+            k_pool, v_pool = _pin_standard_layout(k_pool, v_pool)
+            return k_pool, v_pool, tokens, positions, temps, rng, first
+
+        return prefill
+
+    def _prefill_program(self, bucket: int, K: int):
+        jnp = self._jnp
+        n_ptable = max(1, math.ceil(bucket / self.page_size))
+        args = (self.params, self.k_cache, self.v_cache,
+                jnp.zeros((K, bucket), dtype=jnp.int32),
+                jnp.zeros((K, n_ptable), dtype=jnp.int32),
+                jnp.zeros((K,), dtype=jnp.int32),
+                jnp.ones((K,), dtype=jnp.int32),
+                self._tokens, self._positions, self._temps,
+                jnp.zeros((K,), dtype=jnp.float32), self.rng)
+        return self.executor.compile(
+            f"llama-paged-prefill-{bucket}x{K}",
+            self._prefill_fn(bucket, K),
+            args, donate_argnums=(1, 2, 7, 8, 9))
+
+    def _decode_fn_paged(self, block: int, n_table: int):
+        cfg = self.cfg
+        top_k = self.top_k
+        import jax
+
+        from .sampling import sample_tokens
+
+        def decode(params, k_pool, v_pool, table, tokens, positions, temps,
+                   rng):
+            """`block` paged decode steps under scan; table [B, n_table]."""
+
+            def step(carry, _):
+                kp, vp, tok, pos, rng = carry
+                logits, kp, vp = llama_decode_step_paged(
+                    params, cfg, tok, pos, kp, vp, table)
+                nxt, rng = sample_tokens(logits, rng, temps, top_k=top_k)
+                return (kp, vp, nxt, pos + 1, rng), nxt
+
+            k_pool, v_pool = _pin_standard_layout(k_pool, v_pool)
+            (k_pool, v_pool, tok, pos, rng), out = jax.lax.scan(
+                step, (k_pool, v_pool, tokens, positions, rng), None,
+                length=block)
+            k_pool, v_pool = _pin_standard_layout(k_pool, v_pool)
+            return k_pool, v_pool, tok, pos, rng, out.T
+
+        return decode
+
+    def _decode_program_paged(self, n_table: int):
+        jnp = self._jnp
+        block = self.decode_block_size
+        args = (self.params, self.k_cache, self.v_cache,
+                jnp.zeros((self.n_slots, n_table), dtype=jnp.int32),
+                self._tokens, self._positions, self._temps, self.rng)
+        return self.executor.compile(
+            f"llama-paged-decode-x{block}-NP{n_table}",
+            self._decode_fn_paged(block, n_table), args,
+            donate_argnums=(1, 2))
+
+    # -- dispatch -------------------------------------------------------------
+    def _dispatch_prefill(self, bucket: int, slots_idx: List[int],
+                          batch: List[GenerationRequest]) -> None:
+        K = len(batch)
+        jnp = self._jnp
+        ptokens, lengths, new_temps = self._prep_admission(bucket, batch)
+        n_ptable = max(1, math.ceil(bucket / self.page_size))
+        ptable = np.zeros((K, n_ptable), dtype=np.int32)
+        for row, request in enumerate(batch):
+            pages = self._reservations.get(request.id)
+            if pages is None:  # direct submit path outside _admit (tests)
+                pages = self.allocator.alloc(self._request_pages(request))
+                if pages is None:
+                    raise RuntimeError("page pool exhausted at dispatch")
+                self._reservations[request.id] = pages
+            prompt_pages = pages[:n_ptable]
+            ptable[row, :len(prompt_pages)] = prompt_pages
+
+        program = self._prefill_program(bucket, K)
+        try:
+            (self.k_cache, self.v_cache, self._tokens, self._positions,
+             self._temps, self.rng, first) = program(
+                self.params, self.k_cache, self.v_cache,
+                jnp.asarray(ptokens), jnp.asarray(ptable),
+                jnp.asarray(np.asarray(slots_idx, dtype=np.int32)),
+                jnp.asarray(lengths), self._tokens, self._positions,
+                self._temps, jnp.asarray(new_temps), self.rng)
+        except Exception as exc:
+            raise CacheLostError(f"paged prefill dispatch failed: {exc}") from exc
+
+        self._bind_slots(slots_idx, batch, first)
+        for row, request in enumerate(batch):
+            self.slots[slots_idx[row]].pages = self._reservations.pop(request.id)
+
+    def _dispatch_decode(self) -> None:
+        import time as _time
+
+        jnp = self._jnp
+        active = [(i, slot) for i, slot in enumerate(self.slots) if slot.active]
+        widest = max(len(slot.pages) for _, slot in active)
+        # +1 garbage column: a speculative overrun position clamps its
+        # page_slot to the LAST column, which must be garbage (0) for every
+        # row so dead steps can never write into a live page
+        n_table = _pow2_at_least(widest + 1)
+        table = np.zeros((self.n_slots, n_table), dtype=np.int32)
+        for i, slot in active:
+            table[i, :len(slot.pages)] = slot.pages
+        program = self._decode_program_paged(n_table)
+        snapshot = [(i, slot.request) for i, slot in active]
+        start = _time.time()
+        try:
+            (self.k_cache, self.v_cache, self._tokens, self._positions,
+             self.rng, out_tokens) = program(
+                self.params, self.k_cache, self.v_cache, jnp.asarray(table),
+                self._tokens, self._positions, self._temps, self.rng)
+        except Exception as exc:
+            raise CacheLostError(f"paged decode dispatch failed: {exc}") from exc
+        self._inflight.append(("decode", out_tokens, snapshot,
+                               self.decode_block_size, start))
+
+    def _reset_device_state(self, exc: BaseException) -> None:
+        # releasing slot pages happens via _finish_slot inside super(),
+        # against the old allocator; _init_device_state then rebuilds the
+        # allocator wholesale (super holds the state lock; only the loop
+        # thread touches _reservations, so clearing here is safe)
+        self._reservations.clear()
+        super()._reset_device_state(exc)

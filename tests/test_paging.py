@@ -1,0 +1,210 @@
+"""Paged KV serving: kernel numerics, allocator ledger, engine behavior.
+
+The load-bearing assertions (VERDICT r2 missing #4 "done" criteria):
+  - paged engine output == dense engine output token-for-token
+  - HBM pool bytes and page usage track the SUM of live contexts, not
+    max_seq x n_slots (mixed 16-token and long contexts share one pool)
+  - admission defers when the pool is exhausted and resumes on free
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gofr_tpu.models.llama import LlamaConfig, llama_init
+from gofr_tpu.ops.paged_attention import (paged_attention,
+                                          paged_attention_reference,
+                                          paged_write_decode,
+                                          paged_write_prefill)
+from gofr_tpu.tpu.engine import LLMEngine
+from gofr_tpu.tpu.paging import PageAllocator, PagedLLMEngine
+
+CFG = LlamaConfig.debug()
+
+
+class MockLogger:
+    def debugf(self, *a): pass
+    def infof(self, *a): pass
+    def warnf(self, *a): pass
+    def errorf(self, *a): pass
+
+
+# -- kernel -------------------------------------------------------------------
+def test_paged_attention_kernel_matches_reference():
+    rng = np.random.default_rng(0)
+    B, H, Hkv, dh, ps, P, NP = 3, 4, 2, 16, 8, 10, 4
+    q = jnp.asarray(rng.normal(size=(B, H, dh)), dtype=jnp.float32)
+    k_pool = jnp.asarray(rng.normal(size=(P, Hkv, dh, ps)), dtype=jnp.float32)
+    v_pool = jnp.asarray(rng.normal(size=(P, Hkv, dh, ps)), dtype=jnp.float32)
+    table = jnp.asarray(rng.integers(0, P, size=(B, NP)), dtype=jnp.int32)
+    lengths = jnp.asarray([5, 17, 32], dtype=jnp.int32)
+
+    ref = paged_attention_reference(q, k_pool, v_pool, table, lengths)
+    out = paged_attention(q, k_pool, v_pool, table, lengths)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_paged_writes_round_trip():
+    rng = np.random.default_rng(1)
+    Hkv, dh, ps, P = 2, 16, 8, 12
+    k_pool = jnp.zeros((P, Hkv, dh, ps), dtype=jnp.float32)
+    v_pool = jnp.zeros_like(k_pool)
+
+    # prefill: 11 tokens over pages [2, 3]; junk past length=11 -> garbage
+    K, T = 1, 16
+    kpre = jnp.asarray(rng.normal(size=(K, T, Hkv, dh)), dtype=jnp.float32)
+    table = jnp.asarray([[2, 3]], dtype=jnp.int32)
+    lens = jnp.asarray([11], dtype=jnp.int32)
+    kp, vp = paged_write_prefill(k_pool, v_pool, kpre, kpre, table, lens)
+    np.testing.assert_array_equal(np.asarray(kp[2, :, :, 5]),
+                                  np.asarray(kpre[0, 5]))
+    np.testing.assert_array_equal(np.asarray(kp[3, :, :, 2]),
+                                  np.asarray(kpre[0, 10]))
+    assert np.all(np.asarray(kp[3, :, :, 3:]) == 0)  # junk went to garbage
+
+    # decode write at position 11 -> page 3, offset 3
+    knew = jnp.asarray(rng.normal(size=(1, Hkv, dh)), dtype=jnp.float32)
+    kp, vp = paged_write_decode(kp, vp, knew, knew, table,
+                                jnp.asarray([11], dtype=jnp.int32))
+    np.testing.assert_array_equal(np.asarray(kp[3, :, :, 3]),
+                                  np.asarray(knew[0]))
+
+
+# -- allocator ----------------------------------------------------------------
+def test_page_allocator_ledger():
+    a = PageAllocator(n_pages=9, page_size=16)
+    assert a.free_pages == 8  # page 0 reserved as garbage
+    assert a.garbage_page == 0
+    assert 0 not in a.alloc(8)  # garbage page is never handed out
+    a = PageAllocator(n_pages=9, page_size=16)
+    assert a.pages_for(1) == 1 and a.pages_for(16) == 1 and a.pages_for(17) == 2
+    got = a.alloc(5)
+    assert len(got) == 5 and a.free_pages == 3
+    assert a.alloc(4) is None          # insufficient: nothing taken
+    assert a.free_pages == 3
+    a.release(got[:2])
+    assert a.free_pages == 5
+    assert a.used_pages == 3
+
+
+# -- engine -------------------------------------------------------------------
+def _make_paged(**kw):
+    params = llama_init(CFG, seed=0)
+    defaults = dict(n_slots=4, max_seq_len=64, prefill_buckets=(8, 16),
+                    page_size=8, logger=MockLogger())
+    defaults.update(kw)
+    eng = PagedLLMEngine(params, CFG, **defaults)
+    eng.start()
+    return eng
+
+
+def test_paged_engine_matches_dense_engine():
+    """Token-for-token parity with the dense engine under greedy decode."""
+    params = llama_init(CFG, seed=0)
+    prompts = [[5, 6, 7], [9, 10, 11, 12, 13, 14, 15, 16, 17], [1, 2]]
+
+    dense = LLMEngine(params, CFG, n_slots=4, max_seq_len=64,
+                      prefill_buckets=(8, 16), logger=MockLogger())
+    dense.start()
+    try:
+        want = [dense.generate(p, max_new_tokens=8, temperature=0.0)
+                for p in prompts]
+    finally:
+        dense.stop()
+
+    paged = _make_paged()
+    try:
+        got = [paged.generate(p, max_new_tokens=8, temperature=0.0)
+               for p in prompts]
+    finally:
+        paged.stop()
+    assert got == want
+
+
+def test_paged_engine_concurrent_mixed_lengths():
+    """Mixed short/long contexts share the pool; usage tracks the SUM of
+    live pages (a short context is NOT billed for the longest's length)."""
+    eng = _make_paged(n_slots=4, max_seq_len=64, page_size=8,
+                      n_pages=4 * 8 + 1)
+    try:
+        long_req = eng.submit(list(range(1, 15)), max_new_tokens=24,
+                              temperature=0.0)   # 38 tokens -> 5 pages
+        short_req = eng.submit([3, 4], max_new_tokens=4,
+                               temperature=0.0)  # 6 tokens -> 1 page
+        while not (long_req.generated and short_req.generated):
+            time.sleep(0.01)
+        # while both are live: 5 + 1 pages, not 2 x pages(max_seq)
+        assert eng.allocator.used_pages == 6
+        short_req.result(timeout_s=60)
+        long_req.result(timeout_s=60)
+        deadline = time.time() + 5
+        while eng.allocator.used_pages and time.time() < deadline:
+            time.sleep(0.01)
+        assert eng.allocator.used_pages == 0  # everything returned
+    finally:
+        eng.stop()
+
+
+def test_paged_pool_bytes_track_budget_not_dense_worstcase():
+    """The pool is the explicit budget: sized at n_pages, independent of
+    n_slots x max_seq_len."""
+    eng = _make_paged(n_slots=4, max_seq_len=64, page_size=8, n_pages=9)
+    try:
+        dense_equiv = 2 * (CFG.n_layers * 4 * CFG.n_kv_heads * CFG.head_dim
+                           * 64 * 4)  # f32 dense cache bytes at max_seq
+        assert eng.pool_bytes() < dense_equiv / 3
+    finally:
+        eng.stop()
+
+
+def test_paged_admission_defers_until_pages_free():
+    """With a pool that fits ONE request's reservation, the second request
+    must wait (not fail) and complete after the first releases."""
+    # 6 tokens @ ps=8 -> 1 page; pool has 2 usable pages; each request
+    # reserves 2 pages (2 + 4 tokens... make it explicit:
+    eng = _make_paged(n_slots=4, max_seq_len=64, page_size=8,
+                      n_pages=3)  # 2 usable + garbage
+    try:
+        r1 = eng.submit([1, 2, 3, 4, 5, 6, 7, 8], max_new_tokens=8,
+                        temperature=0.0)  # 16 tokens -> 2 pages (all of them)
+        r2 = eng.submit([9, 10], max_new_tokens=4,
+                        temperature=0.0)  # 6 tokens -> 1 page: must wait
+        out1 = r1.result(timeout_s=120)
+        out2 = r2.result(timeout_s=120)
+        assert len(out1) == 8 and len(out2) == 4
+        # waiting was observed (metric is best-effort; ordering is the test)
+        assert r2.finished_at >= r1.finished_at
+    finally:
+        eng.stop()
+
+
+def test_paged_submit_rejects_impossible_reservation():
+    """A request that could NEVER fit the pool is rejected at submit —
+    deferring it would head-of-line-block all later admission forever."""
+    eng = _make_paged(n_slots=2, max_seq_len=64, page_size=8, n_pages=3)
+    try:
+        with pytest.raises(ValueError, match="pool has only 2 usable"):
+            eng.submit(list(range(1, 20)), max_new_tokens=32)  # 7 pages
+        # a fitting request still serves
+        assert len(eng.generate([1, 2], max_new_tokens=3)) == 3
+    finally:
+        eng.stop()
+
+
+def test_paged_engine_streaming_and_stop_tokens():
+    eng = _make_paged()
+    try:
+        req = eng.submit([1, 2, 3], max_new_tokens=16, temperature=0.0)
+        toks = list(req.stream(timeout_s=60))
+        assert len(toks) == 16
+        want = eng.generate([1, 2, 3], max_new_tokens=16, temperature=0.0)
+        assert toks == want
+        stop = eng.generate([1, 2, 3], max_new_tokens=16, temperature=0.0,
+                            stop_tokens={want[2]})
+        assert stop == want[:3]
+    finally:
+        eng.stop()

@@ -292,6 +292,83 @@ def test_engine_admission_split():
     assert _admission_split(100, 128) == [64, 16, 16, 4]
 
 
+def test_engine_flash_prefill_matches_xla():
+    """attn_impl="flash" routes serving prefill through the Pallas kernel
+    (full-window T == S case); greedy tokens must match the dense path."""
+    import dataclasses
+
+    from gofr_tpu.models.llama import LlamaConfig, llama_init
+    from gofr_tpu.tpu.engine import LLMEngine
+
+    prompts = [[5, 6, 7], [9, 10, 11, 12, 13, 14], [1, 2]]
+    outs = {}
+    for impl in ("xla", "flash"):
+        cfg = dataclasses.replace(LlamaConfig.debug(), attn_impl=impl)
+        eng = LLMEngine(llama_init(cfg, seed=0), cfg, n_slots=4,
+                        max_seq_len=64, prefill_buckets=(8,),
+                        logger=MockLogger())
+        eng.start()
+        try:
+            outs[impl] = [eng.generate(p, max_new_tokens=6, temperature=0.0)
+                          for p in prompts]
+        finally:
+            eng.stop()
+    assert outs["flash"] == outs["xla"]
+
+
+def test_engine_host_prep_error_fails_only_that_wave():
+    """A host-side failure BEFORE device dispatch fails the one admission
+    wave; active requests and device state survive (VERDICT r2 weak #5)."""
+    from gofr_tpu import native
+    from gofr_tpu.models.llama import LlamaConfig, llama_init
+    from gofr_tpu.tpu.engine import LLMEngine
+
+    cfg = LlamaConfig.debug()
+    eng = LLMEngine(llama_init(cfg, seed=0), cfg, n_slots=4, max_seq_len=64,
+                    prefill_buckets=(8,), logger=MockLogger())
+    eng.start()
+    try:
+        # a long-running request that must SURVIVE the other wave's failure
+        survivor = eng.submit([1, 2, 3], max_new_tokens=40, temperature=0.0)
+        while survivor.generated == 0:
+            time.sleep(0.01)
+
+        real_pad = native.pad_batch
+
+        def boom(*a, **kw):
+            raise RuntimeError("host prep exploded")
+
+        native.pad_batch = boom
+        try:
+            doomed = eng.submit([4, 5, 6], max_new_tokens=4, temperature=0.0)
+            with pytest.raises(RuntimeError, match="host prep exploded"):
+                doomed.result(timeout_s=30)
+        finally:
+            native.pad_batch = real_pad
+
+        # the survivor finishes normally: no engine reset happened
+        out = survivor.result(timeout_s=60)
+        assert len(out) == 40
+        # and the engine still admits new work
+        assert len(eng.generate([7, 8], max_new_tokens=3)) == 3
+    finally:
+        eng.stop()
+
+
+def test_histogram_record_n_batches():
+    from gofr_tpu.metrics import new_metrics_manager
+
+    m = new_metrics_manager()
+    m.new_histogram("h", "batched", buckets=(0.1, 1.0))
+    m.record_histogram_n("h", 0.05, 7)
+    m.record_histogram_n("h", 0.5, 0)  # no-op
+    h = m.get("h")
+    entry = h.series[tuple()]
+    assert entry["count"] == 7
+    assert entry["sum"] == pytest.approx(0.35)
+    assert entry["counts"][0] == 7
+
+
 def test_engine_stop_unblocks_active_requests():
     """stop() must fail mid-generation requests, never deadlock their clients."""
     from gofr_tpu.models.llama import LlamaConfig, llama_init
