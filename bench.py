@@ -292,6 +292,7 @@ def main() -> None:
         return run_phase_throughput(eng, short_prompts, max_new,
                                     rounds=2 if full_run else 1)
 
+    t0_retry = False
     try:
         tok_s, tokens, elapsed, t0_ttfts = phase_t0(engine)
     except Exception as exc:  # noqa: BLE001
@@ -301,6 +302,11 @@ def main() -> None:
         engine.stop()
         n_slots = max(1, engine.n_slots // 2)
         engine = None  # drop the old device buffers before re-allocating
+        t0_retry = True
+    if t0_retry:
+        # retry OUTSIDE the except block — exc.__traceback__ would pin the
+        # failed phase's frames (and the old engine's cache buffers) while
+        # the halved-config engine allocates
         record.rename_slots(n_slots)
         record.update(t0_oom_degraded_to_slots=n_slots)
         engine = make_engine(n_slots, max_seq, cfg)

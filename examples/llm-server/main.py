@@ -35,10 +35,22 @@ def build_engine(app: App) -> LLMEngine:
     app.add_tpu(tpu)
     preset = app.config.get_or_default("MODEL_PRESET", "debug")
     cfg = PRESETS[preset]()
-    # byte tokenizer unless a vocab file is deployed
-    tokenizer = ByteTokenizer()
+    # VOCAB_PATH deploys a real model vocabulary (JSON {vocab, merges},
+    # BPETokenizer.from_file — native merge loop when the C++ lib is built);
+    # without it the exact-and-reversible byte tokenizer serves
+    vocab_path = app.config.get_or_default("VOCAB_PATH", "")
+    if vocab_path:
+        from gofr_tpu.models.tokenizer import BPETokenizer
+
+        tokenizer = BPETokenizer.from_file(vocab_path)
+        app.logger.infof("loaded BPE vocab from %s (%d tokens, native=%s)",
+                         vocab_path, tokenizer.vocab_size,
+                         tokenizer._native is not None)
+    else:
+        tokenizer = ByteTokenizer()
     if cfg.vocab_size < tokenizer.vocab_size:
-        raise ValueError("model vocab too small for byte tokenizer")
+        raise ValueError(f"model vocab ({cfg.vocab_size}) too small for "
+                         f"tokenizer ({tokenizer.vocab_size})")
     app.logger.infof("initialising %s (%.2fB params)...", preset,
                      cfg.param_count() / 1e9)
     params = llama_init(cfg, seed=0)
@@ -56,6 +68,7 @@ def build_engine(app: App) -> LLMEngine:
         metrics=app.container.metrics_manager,
         logger=app.logger,
         mesh=mesh,
+        tracer=app.container.tracer,
     )
     engine.tokenizer = tokenizer
     engine.start()
@@ -84,7 +97,8 @@ def main() -> None:
 
         request = engine.submit(
             tokenizer.encode(prompt), max_new_tokens=max_tokens,
-            temperature=temperature, stop_tokens={tokenizer.EOS})
+            temperature=temperature, stop_tokens={tokenizer.EOS},
+            span=ctx.span)  # batch.id/slot correlation lands on this span
 
         if not stream:
             from gofr_tpu.http.errors import RequestTimeout

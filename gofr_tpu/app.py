@@ -158,6 +158,22 @@ class App:
     def enable_oauth(self, secret: str) -> None:
         self.router.use_middleware(mw.oauth_middleware(secret))
 
+    def enable_oauth_jwks(self, jwks_url: str,
+                          refresh_interval_s: float = 300.0,
+                          keyset=None) -> None:
+        """RS256 bearer-JWT auth against a background-refreshed JWKS endpoint
+        (reference oauth.go:53-140). Gated on the `cryptography` package:
+        misconfiguration logs and skips rather than failing boot, matching
+        the reference's nil-datasource posture."""
+        try:
+            keyset = keyset or mw.JWKSKeySet(
+                jwks_url, refresh_interval_s=refresh_interval_s,
+                logger=self.logger)
+        except RuntimeError as exc:
+            self.logger.errorf("OAuth JWKS disabled: %s", exc)
+            return
+        self.router.use_middleware(mw.oauth_jwks_middleware(keyset))
+
     def enable_profiler(self, path: str = "/debug/profile") -> None:
         """Expose on-demand xprof device-trace capture (tpu/profiler.py)."""
         from .tpu.profiler import install_routes

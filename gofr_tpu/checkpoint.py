@@ -167,6 +167,19 @@ class CheckpointManager:
         }
         with open(os.path.join(tmp, "manifest.json"), "w") as fp:
             json.dump(manifest, fp)
+            fp.flush()
+            os.fsync(fp.fileno())
+        # fsync file contents + the tmp directory entry BEFORE the rename:
+        # os.replace is only atomic for what has reached disk — a crash
+        # after rename-but-before-writeback could leave a complete-looking
+        # checkpoint with truncated arrays
+        with open(os.path.join(tmp, "arrays.npz"), "rb+") as fp:
+            os.fsync(fp.fileno())
+        dirfd = os.open(tmp, os.O_RDONLY)
+        try:
+            os.fsync(dirfd)
+        finally:
+            os.close(dirfd)
         # never a moment without a complete copy on disk: move the old
         # checkpoint aside, swing tmp in, then drop the old one; _recover()
         # handles a crash in the window between the two renames

@@ -204,6 +204,15 @@ class DocumentStore:
             if plain:
                 raise ValueError("cannot mix update operators with plain fields")
 
+        # validate $inc deltas BEFORE any document is touched: applying a
+        # non-numeric delta mid-iteration would leave earlier matches
+        # updated and later ones not (mongo rejects non-numeric $inc too)
+        for key, delta in operators.get("$inc", {}).items():
+            if isinstance(delta, bool) or not isinstance(delta, (int, float)):
+                raise ValueError(
+                    f"$inc delta for {key!r} must be numeric, got "
+                    f"{type(delta).__name__}")
+
         def apply(d: Dict[str, Any]) -> None:
             if not operators:
                 d.update(copy.deepcopy(update))
@@ -213,6 +222,7 @@ class DocumentStore:
             for key in operators.get("$unset", {}):
                 d.pop(key, None)
             for key, delta in operators.get("$inc", {}).items():
+                # target types were dry-run-validated before mutation below
                 d[key] = d.get(key, 0) + delta
 
         count = 0

@@ -4,7 +4,9 @@ Parity: reference pkg/gofr/http/middleware/ — tracer.go:15-32 (extract W3C
 traceparent, span per request), logger.go:69-150 (status-capturing request log
 + panic recovery -> 500), cors.go:6-22, metrics.go:21-42 (app_http_response
 histogram by path/method/status), basic_auth.go:18-72, apikey_auth.go:11-57,
-oauth.go:53-140 (JWT w/ background JWKS refresh -> here HMAC/static-key JWT),
+oauth.go:53-140 (JWT w/ background JWKS refresh -> oauth_jwks_middleware
+validates RS256 against a kid-indexed, background-refreshed JWKSKeySet;
+oauth_middleware keeps an HS256 shared-secret path for zero-egress deploys),
 validate.go:5-7 (/.well-known bypass for auth).
 """
 
@@ -14,8 +16,9 @@ import base64
 import hashlib
 import hmac
 import json
+import threading
 import time
-from typing import Callable, Iterable, Optional
+from typing import Callable, Dict, Iterable, Optional
 
 from ...logging import PrettyPrint
 from ..errors import PanicRecovery
@@ -234,10 +237,143 @@ def jwt_decode(token: str, secret: str) -> Optional[dict]:
     return claims
 
 
+# -- JWT (RS256 via JWKS) -----------------------------------------------------
+class JWKSKeySet:
+    """kid-indexed RSA public keys fetched from a JWKS endpoint, refreshed in
+    the background — parity with the reference's OAuth provider polling
+    (oauth.go:53-140: NewOAuth spawns a refresh goroutine on an interval).
+
+    Gated on the `cryptography` package for the signature math; construction
+    raises cleanly when it is absent (the reference's nil-on-misconfig
+    posture is handled by enable_oauth logging and skipping)."""
+
+    def __init__(self, url: str, refresh_interval_s: float = 300.0,
+                 logger=None, fetch=None):
+        try:
+            from cryptography.hazmat.primitives.asymmetric import rsa  # noqa: F401
+        except ImportError as exc:  # pragma: no cover - env has it
+            raise RuntimeError(
+                "RS256 JWKS requires the 'cryptography' package") from exc
+        self.url = url
+        self.refresh_interval_s = refresh_interval_s
+        self.logger = logger
+        self._fetch = fetch or self._http_fetch
+        self._keys: Dict[str, tuple] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self.refresh()
+        self._thread = threading.Thread(target=self._refresh_loop,
+                                        name="jwks-refresh", daemon=True)
+        self._thread.start()
+
+    def _http_fetch(self) -> dict:
+        import urllib.request
+
+        with urllib.request.urlopen(self.url, timeout=10) as resp:
+            return json.loads(resp.read().decode())
+
+    def refresh(self) -> None:
+        try:
+            doc = self._fetch()
+            keys = {}
+            for key in doc.get("keys", []):
+                if key.get("kty") != "RSA":
+                    continue
+                kid = key.get("kid", "")
+                n = int.from_bytes(_b64url_decode(key["n"]), "big")
+                e = int.from_bytes(_b64url_decode(key["e"]), "big")
+                keys[kid] = (n, e)
+            with self._lock:
+                self._keys = keys
+        except Exception as exc:  # noqa: BLE001 - keep serving old keys
+            if self.logger is not None:
+                self.logger.errorf("JWKS refresh from %s failed: %s",
+                                   self.url, exc)
+
+    def _refresh_loop(self) -> None:
+        while not self._stop.wait(self.refresh_interval_s):
+            self.refresh()
+
+    def close(self) -> None:
+        self._stop.set()
+
+    def get(self, kid: str):
+        with self._lock:
+            return self._keys.get(kid)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._keys)
+
+
+def rs256_verify(signing_input: bytes, signature: bytes, n: int, e: int) -> bool:
+    """RSASSA-PKCS1-v1_5 SHA-256 verification against a public (n, e)."""
+    from cryptography.exceptions import InvalidSignature
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.hazmat.primitives.asymmetric import padding, rsa
+
+    try:
+        pub = rsa.RSAPublicNumbers(e, n).public_key()
+        pub.verify(signature, signing_input, padding.PKCS1v15(),
+                   hashes.SHA256())
+        return True
+    except (InvalidSignature, ValueError):
+        return False
+
+
+def jwt_decode_rs256(token: str, keyset: JWKSKeySet) -> Optional[dict]:
+    """Validate an RS256 bearer JWT against the JWKS keys (kid-matched)."""
+    parts = token.split(".")
+    if len(parts) != 3:
+        return None
+    try:
+        header = json.loads(_b64url_decode(parts[0]))
+        if header.get("alg") != "RS256":  # no alg-confusion downgrades
+            return None
+        key = keyset.get(header.get("kid", ""))
+        if key is None:
+            return None
+        signing = f"{parts[0]}.{parts[1]}".encode()
+        if not rs256_verify(signing, _b64url_decode(parts[2]), *key):
+            return None
+        claims = json.loads(_b64url_decode(parts[1]))
+    except Exception:  # noqa: BLE001
+        return None
+    exp = claims.get("exp")
+    if exp is not None and time.time() > float(exp):
+        return None
+    return claims
+
+
+def oauth_jwks_middleware(keyset: JWKSKeySet):
+    """Bearer-JWT validation against background-refreshed RSA JWKS — the
+    reference's OAuth shape (oauth.go:53-140)."""
+
+    def mw(inner: WireHandler) -> WireHandler:
+        def handle(request: Request) -> Response:
+            if _is_well_known(request):
+                return inner(request)
+            header = request.headers.get("authorization", "")
+            if not header.startswith("Bearer "):
+                return _unauthorized()
+            claims = jwt_decode_rs256(header[7:], keyset)
+            if claims is None:
+                return _unauthorized("invalid or expired token")
+            request.auth_subject = str(claims.get("sub", ""))
+            request.context["jwt_claims"] = claims
+            return inner(request)
+
+        return handle
+
+    return mw
+
+
 def oauth_middleware(secret: str):
-    """Bearer-JWT validation. The reference refreshes RSA JWKS in the background
-    (oauth.go:53-140); with zero egress we validate HS256 against a shared
-    secret, keeping the same claim checks (exp) and claim propagation."""
+    """Bearer-JWT validation (HS256 shared secret). For provider-issued RSA
+    tokens use oauth_jwks_middleware, which validates RS256 against a
+    background-refreshed JWKS endpoint like the reference (oauth.go:53-140);
+    HS256 remains for zero-egress deployments. Claim checks (exp) and claim
+    propagation are identical on both paths."""
 
     def mw(inner: WireHandler) -> WireHandler:
         def handle(request: Request) -> Response:

@@ -43,15 +43,22 @@ class ByteTokenizer:
 
 class StreamingDecoder:
     """Accumulates byte tokens and yields complete UTF-8 characters — what the
-    SSE token stream sends so clients never see broken codepoints."""
+    SSE token stream sends so clients never see broken codepoints.
 
-    def __init__(self, tokenizer: Optional[ByteTokenizer] = None):
+    BPE tokenizers emit whole string pieces per token, so their streaming
+    decode is just decode_token; only byte-level tokenizers need the UTF-8
+    boundary buffering."""
+
+    def __init__(self, tokenizer=None):
         self.tokenizer = tokenizer or ByteTokenizer()
         self._buf = bytearray()
+        self._piecewise = not isinstance(self.tokenizer, ByteTokenizer)
 
     def push(self, token: int) -> str:
         from .. import native
 
+        if self._piecewise:
+            return self.tokenizer.decode_token(token)
         if not (0 <= token < 256):
             return ""
         self._buf.append(token)
@@ -87,6 +94,23 @@ class BPETokenizer:
         self.eos_id = vocab.get(eos_token)
         self.vocab_size = max(vocab.values()) + 1 if vocab else 0
         self._native = self._build_native(merges)
+
+    # ByteTokenizer-compatible special-token surface, so serving code can
+    # swap tokenizers via config without branching (-1 = "no such token",
+    # which never matches a generated id)
+    @property
+    def BOS(self) -> int:
+        return self.bos_id if self.bos_id is not None else -1
+
+    @property
+    def EOS(self) -> int:
+        return self.eos_id if self.eos_id is not None else -1
+
+    def decode_token(self, token: int) -> str:
+        """Single-token streaming decode: BPE pieces are whole strings."""
+        if token in (self.bos_id, self.eos_id):
+            return ""
+        return self.inv_vocab.get(token, "")
 
     def _build_native(self, merges: List[str]):
         """Hot-path merge loop in C++ when every merge is id-representable

@@ -285,9 +285,17 @@ class GooglePubSubAdapter(Client):
         deadline = None if timeout_s is None else time.time() + timeout_s
         while True:
             remaining = 5.0 if deadline is None else max(deadline - time.time(), 0.1)
-            resp = self._subscriber.pull(subscription=sub_path, max_messages=1,
-                                         timeout=remaining)
-            if resp.received_messages:
+            try:
+                resp = self._subscriber.pull(subscription=sub_path,
+                                             max_messages=1, timeout=remaining)
+            except Exception as exc:  # noqa: BLE001
+                # an empty pull surfaces as DeadlineExceeded in the google
+                # client — that is "no message yet", not an error; anything
+                # else is a real failure and must propagate, not be spun on
+                if type(exc).__name__ != "DeadlineExceeded":
+                    raise
+                resp = None
+            if resp is not None and resp.received_messages:
                 break
             if deadline is not None and time.time() >= deadline:
                 return None

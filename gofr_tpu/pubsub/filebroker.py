@@ -283,6 +283,10 @@ class FileBroker(Client):
                         new_committed += 1
                     if new_committed > committed:
                         self._write_offset(topic, group, new_committed)
+                    # prune acks below the watermark (stale double-acks from
+                    # crashed peers) so the persisted list cannot grow
+                    # unboundedly over the broker's lifetime
+                    acked = {i for i in acked if i >= new_committed}
                     self._write_state(lf, {"claims": claims,
                                            "acked": sorted(acked)})
                 finally:

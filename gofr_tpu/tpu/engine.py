@@ -60,12 +60,17 @@ _request_ids = itertools.count(1)
 
 class GenerationRequest:
     def __init__(self, prompt_tokens: Sequence[int], max_new_tokens: int = 128,
-                 temperature: float = 0.0, stop_tokens: Optional[Set[int]] = None):
+                 temperature: float = 0.0, stop_tokens: Optional[Set[int]] = None,
+                 span=None):
         self.id = next(_request_ids)
         self.prompt_tokens = list(prompt_tokens)
         self.max_new_tokens = max_new_tokens
         self.temperature = float(temperature)
         self.stop_tokens = stop_tokens or set()
+        # the caller's trace span: the engine stamps batch.id/tpu.slot/
+        # tpu.prefill_bucket on it at admission so one request's trace
+        # covers its slot in the fused batch (SURVEY §5 tracing row)
+        self.span = span
         self.out_queue: "queue.Queue" = queue.Queue()
         self.cancelled = threading.Event()
         self.error: Optional[BaseException] = None
@@ -155,6 +160,10 @@ def _admission_split(n: int, cap: int) -> List[int]:
 
 
 class LLMEngine:
+    # capacity-plan mode: the paged subclass plans without the dense cache's
+    # growth/ping-pong transient (its pool is fixed and never carried whole)
+    _plan_paged = False
+
     def __init__(
         self,
         params,
@@ -172,6 +181,7 @@ class LLMEngine:
         seed: int = 0,
         mesh=None,
         budget_bytes: Optional[int] = None,
+        tracer=None,
     ):
         """mesh: optional jax.sharding.Mesh with a "tp" axis. When given, the
         engine serves TENSOR-PARALLEL: params shard per serving_param_specs
@@ -213,7 +223,8 @@ class LLMEngine:
 
             self.plan = plan_capacity(cfg, self.n_slots, self.max_seq_len,
                                       budget_bytes,
-                                      prefill_buckets=self.prefill_buckets)
+                                      prefill_buckets=self.prefill_buckets,
+                                      paged=self._plan_paged)
             self.n_slots = self.plan.n_slots
             self.max_seq_len = self.plan.max_seq_len
             self.prefill_buckets = self.plan.prefill_buckets
@@ -245,6 +256,8 @@ class LLMEngine:
         self._state_lock = threading.Lock()
         self._jnp = jnp
         self._obs = MetricsHook(self.metrics)
+        self.tracer = tracer
+        self._batch_seq = itertools.count(1)
 
         # in-flight dispatches awaiting host sync, processed FIFO:
         #   ("decode", out_tokens [B, M] future, [(slot_idx, request)], M)
@@ -347,7 +360,8 @@ class LLMEngine:
 
     def submit(self, prompt_tokens: Sequence[int], max_new_tokens: int = 128,
                temperature: float = 0.0,
-               stop_tokens: Optional[Set[int]] = None) -> GenerationRequest:
+               stop_tokens: Optional[Set[int]] = None,
+               span=None) -> GenerationRequest:
         if self._stop.is_set():
             raise RuntimeError("engine is stopped")
         if not prompt_tokens:
@@ -356,7 +370,8 @@ class LLMEngine:
         if len(prompt_tokens) > limit:
             raise ValueError(f"prompt of {len(prompt_tokens)} tokens exceeds the "
                              f"admission limit ({limit})")
-        request = GenerationRequest(prompt_tokens, max_new_tokens, temperature, stop_tokens)
+        request = GenerationRequest(prompt_tokens, max_new_tokens, temperature,
+                                    stop_tokens, span=span)
         self._obs.counter("app_tpu_requests_total")
         self._pending.put(request)
         if self._stop.is_set():
@@ -661,9 +676,24 @@ class LLMEngine:
                                dtype=np.float32)
         return ptokens, lengths, new_temps
 
+    def _dispatch_span(self, name: str, batch_id: int, **attrs):
+        """Span covering one device dispatch (ends at its host sync)."""
+        if self.tracer is None:
+            return None
+        span = self.tracer.start_span(name)
+        span.set_attribute("batch.id", batch_id)
+        for key, value in attrs.items():
+            span.set_attribute(key, value)
+        return span
+
     def _bind_slots(self, slots_idx: List[int],
-                    batch: List[GenerationRequest], first) -> None:
-        """Post-dispatch slot bookkeeping shared by dense and paged."""
+                    batch: List[GenerationRequest], first,
+                    bucket: int, batch_id: int, dspan=None) -> None:
+        """Post-dispatch slot bookkeeping shared by dense and paged.
+
+        Stamps the trace correlation on each request's span: batch.id (the
+        fused dispatch this request rode in), tpu.slot, tpu.prefill_bucket.
+        """
         admitted = []
         for row, request in enumerate(batch):
             slot = self.slots[slots_idx[row]]
@@ -672,8 +702,12 @@ class LLMEngine:
             # first sampled token is written at `length` by the next decode
             slot.length = len(request.prompt_tokens)
             slot.remaining = request.max_new_tokens - 1
+            if request.span is not None:
+                request.span.set_attribute("batch.id", batch_id)
+                request.span.set_attribute("tpu.slot", slots_idx[row])
+                request.span.set_attribute("tpu.prefill_bucket", bucket)
             admitted.append((slots_idx[row], request))
-        self._inflight.append(("prefill", first, admitted))
+        self._inflight.append(("prefill", first, admitted, dspan))
 
     def _dispatch_prefill(self, bucket: int,
                           slots_idx: List[int],
@@ -697,7 +731,11 @@ class LLMEngine:
         except Exception as exc:
             raise CacheLostError(f"prefill dispatch failed: {exc}") from exc
 
-        self._bind_slots(slots_idx, batch, first)
+        batch_id = next(self._batch_seq)
+        dspan = self._dispatch_span("tpu.prefill", batch_id,
+                                    **{"batch.size": K,
+                                       "tpu.prefill_bucket": bucket})
+        self._bind_slots(slots_idx, batch, first, bucket, batch_id, dspan)
 
     def _dispatch_decode(self) -> None:
         # one decode program per allocated cache size: growth keeps the
@@ -718,19 +756,27 @@ class LLMEngine:
                 self._tokens, self._positions, self._temps, self.rng)
         except Exception as exc:
             raise CacheLostError(f"decode dispatch failed: {exc}") from exc
+        dspan = self._dispatch_span("tpu.decode", next(self._batch_seq),
+                                    **{"batch.size": len(snapshot),
+                                       "tpu.block": self.decode_block_size})
         self._inflight.append(("decode", out_tokens, snapshot,
-                               self.decode_block_size, start))
+                               self.decode_block_size, start, dspan))
 
     def _sync_oldest(self) -> None:
         import numpy as np
 
         entry = self._inflight.popleft()
         if entry[0] == "prefill":
-            _, first, admitted = entry
+            _, first, admitted, dspan = entry
             try:
                 first_host = np.asarray(first)  # blocks until the device got there
             except Exception as exc:
+                if dspan is not None:
+                    dspan.set_status(False, str(exc))
+                    dspan.end()
                 raise CacheLostError(f"prefill execution failed: {exc}") from exc
+            if dspan is not None:
+                dspan.end()
             now = time.time()
             for row, (slot_idx, request) in enumerate(admitted):
                 slot = self.slots[slot_idx]
@@ -745,11 +791,16 @@ class LLMEngine:
                     self._finish_slot(slot)
             return
 
-        _, out_tokens, snapshot, block, started = entry
+        _, out_tokens, snapshot, block, started, dspan = entry
         try:
             tokens_host = np.asarray(out_tokens)  # [B, block]; device sync point
         except Exception as exc:
+            if dspan is not None:
+                dspan.set_status(False, str(exc))
+                dspan.end()
             raise CacheLostError(f"decode execution failed: {exc}") from exc
+        if dspan is not None:
+            dspan.end()
         step_s = (time.time() - started) / block
         self._obs.hist("app_tpu_execute_seconds", time.time() - started)
 

@@ -88,6 +88,8 @@ class PagedLLMEngine(LLMEngine):
     (plan_capacity(..., paged=True)) instead of n_slots * max_seq.
     """
 
+    _plan_paged = True  # capacity plan without the dense-cache transients
+
     def __init__(self, params, cfg: LlamaConfig, *, page_size: int = 128,
                  n_pages: Optional[int] = None, **kw):
         self.page_size = page_size
@@ -111,6 +113,20 @@ class PagedLLMEngine(LLMEngine):
         L, Hkv, dh = self.cfg.n_layers, self.cfg.n_kv_heads, self.cfg.head_dim
         dt = {"bfloat16": jnp.bfloat16, "float32": jnp.float32,
               "float16": jnp.float16}[self.cfg.dtype]
+        # the capacity plan (budget_bytes, paged=True) clamped n_slots and
+        # max_seq_len; the pool derived from them must itself fit — check
+        # explicitly, since an explicit n_pages bypasses the plan's sizing
+        pool_bytes = (2 * L * n_pages * Hkv * dh * ps
+                      * {"bfloat16": 2, "float16": 2}.get(self.cfg.dtype, 4))
+        if self.plan is not None:
+            usable = int(self.plan.budget_bytes * 0.92)
+            need = (self.plan.params_bytes + pool_bytes
+                    + self.plan.prefill_temp_bytes)
+            if need > usable:
+                raise ValueError(
+                    f"page pool of {n_pages} pages ({pool_bytes >> 20} MiB) "
+                    f"does not fit the budget: params + pool + prefill temps "
+                    f"= {need >> 20} MiB > {usable >> 20} MiB usable")
         self.k_cache = jnp.zeros((L, n_pages, Hkv, dh, ps), dtype=dt)
         self.v_cache = jnp.zeros_like(self.k_cache)
         B = self.n_slots
@@ -132,7 +148,8 @@ class PagedLLMEngine(LLMEngine):
 
     # -- admission: page reservation ------------------------------------------
     def submit(self, prompt_tokens, max_new_tokens: int = 128,
-               temperature: float = 0.0, stop_tokens=None) -> GenerationRequest:
+               temperature: float = 0.0, stop_tokens=None,
+               span=None) -> GenerationRequest:
         """Reject requests whose reservation could NEVER fit the pool:
         deferring them would head-of-line-block every later request behind
         an allocation that cannot succeed."""
@@ -145,7 +162,7 @@ class PagedLLMEngine(LLMEngine):
                 f"{self.allocator.page_size}) but the pool has only {usable} "
                 f"usable pages; shrink max_new_tokens or grow n_pages")
         return super().submit(prompt_tokens, max_new_tokens, temperature,
-                              stop_tokens)
+                              stop_tokens, span=span)
 
     def _request_pages(self, request: GenerationRequest) -> int:
         total = min(len(request.prompt_tokens) + request.max_new_tokens,
@@ -306,7 +323,11 @@ class PagedLLMEngine(LLMEngine):
         except Exception as exc:
             raise CacheLostError(f"paged prefill dispatch failed: {exc}") from exc
 
-        self._bind_slots(slots_idx, batch, first)
+        batch_id = next(self._batch_seq)
+        dspan = self._dispatch_span("tpu.prefill", batch_id,
+                                    **{"batch.size": K,
+                                       "tpu.prefill_bucket": bucket})
+        self._bind_slots(slots_idx, batch, first, bucket, batch_id, dspan)
         for row, request in enumerate(batch):
             self.slots[slots_idx[row]].pages = self._reservations.pop(request.id)
 
@@ -333,8 +354,12 @@ class PagedLLMEngine(LLMEngine):
                 self._tokens, self._positions, self._temps, self.rng)
         except Exception as exc:
             raise CacheLostError(f"paged decode dispatch failed: {exc}") from exc
+        dspan = self._dispatch_span("tpu.decode", next(self._batch_seq),
+                                    **{"batch.size": len(snapshot),
+                                       "tpu.block": self.decode_block_size,
+                                       "tpu.table_width": n_table})
         self._inflight.append(("decode", out_tokens, snapshot,
-                               self.decode_block_size, start))
+                               self.decode_block_size, start, dspan))
 
     def _reset_device_state(self, exc: BaseException) -> None:
         # releasing slot pages happens via _finish_slot inside super(),

@@ -6,8 +6,11 @@ Context.Trace (context.go:45-51), and ships spans through pluggable exporters
 (pkg/gofr/exporter.go:48-124 custom JSON exporter; zipkin/jaeger variants).
 
 TPU-era addition (SURVEY.md §5): device-step spans and trace-id -> batch-id
-correlation so one request's span covers its slot in a fused batch — the TPU
-scheduler calls `span.set_attribute("batch.id", ...)` on admission.
+correlation so one request's span covers its slot in a fused batch. The
+engine stamps `batch.id`/`tpu.slot`/`tpu.prefill_bucket` on each request's
+span at admission (engine._bind_slots) and, when built with a tracer, emits
+a `tpu.prefill`/`tpu.decode` span per device dispatch that closes at the
+dispatch's host sync (engine._dispatch_span).
 """
 
 from __future__ import annotations

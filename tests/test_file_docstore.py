@@ -166,6 +166,23 @@ def test_docstore_update_operators(tmp_path):
         s.update_one("c", {}, {"$set": {"a": 1}, "plain": 2})
 
 
+def test_docstore_inc_rejects_non_numeric_delta():
+    """A bad DELTA (not just a bad target) must fail before any document is
+    touched — `1 + "x"` mid-batch would leave a partial update."""
+    from gofr_tpu.datasource.docstore import DocumentStore
+
+    s = DocumentStore()
+    s.connect()
+    s.insert_one("c", {"k": "a", "n": 1})
+    s.insert_one("c", {"k": "b", "n": 2})
+    with pytest.raises(ValueError, match="delta.*must be numeric"):
+        s.update_many("c", {}, {"$inc": {"n": "x"}})
+    with pytest.raises(ValueError, match="delta.*must be numeric"):
+        s.update_many("c", {}, {"$inc": {"n": True}})
+    assert s.find_one("c", {"k": "a"})["n"] == 1
+    assert s.find_one("c", {"k": "b"})["n"] == 2
+
+
 def test_docstore_inc_validates_before_mutating():
     from gofr_tpu.datasource.docstore import DocumentStore
 

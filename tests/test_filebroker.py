@@ -235,6 +235,31 @@ def test_claims_work_share_across_processes(broker):
     assert msg.value == b"m1"
 
 
+def test_acked_list_is_pruned_below_watermark(broker):
+    """Stale acks (below the committed watermark) must not accumulate in the
+    persisted group state forever (r1 advisor finding)."""
+    for i in range(6):
+        broker.publish("t", b"m%d" % i)
+    for _ in range(6):
+        broker.subscribe("t", group="g", timeout_s=1).commit()
+    assert broker._committed("t", "g") == 6
+    with open(broker._lease_path("t", "g"), "a+b") as lf:
+        state = broker._read_state(lf)
+    # contiguous committed prefix fully pruned; nothing lingers
+    assert state.get("acked", []) == []
+    # inject a stale ack below the watermark: the next commit sweeps it
+    broker.publish("t", b"m6")
+    msg = broker.subscribe("t", group="g", timeout_s=1)
+    with open(broker._lease_path("t", "g"), "a+b") as lf:
+        state = broker._read_state(lf)
+        state["acked"] = [1, 2]  # stale: watermark is already past these
+        broker._write_state(lf, state)
+    msg.commit()
+    with open(broker._lease_path("t", "g"), "a+b") as lf:
+        state = broker._read_state(lf)
+    assert state.get("acked", []) == []
+
+
 def test_commit_cannot_skip_crashed_peers_record(broker):
     """Out-of-order commit must not advance the watermark past an unacked
     record owned by a dead peer — that record is redelivered, then the

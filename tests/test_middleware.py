@@ -97,6 +97,112 @@ def test_api_key_auth():
     assert handler(make_request(headers={"X-API-Key": "k1"})).status == 200
 
 
+def _make_rsa_jwks():
+    """RSA keypair + JWKS doc + an RS256 signer, via `cryptography`."""
+    import base64
+
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.hazmat.primitives.asymmetric import padding, rsa
+
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    pub = key.public_key().public_numbers()
+
+    def b64url_uint(x: int) -> str:
+        raw = x.to_bytes((x.bit_length() + 7) // 8, "big")
+        return base64.urlsafe_b64encode(raw).rstrip(b"=").decode()
+
+    jwks = {"keys": [{"kty": "RSA", "kid": "kid-1", "alg": "RS256",
+                      "n": b64url_uint(pub.n), "e": b64url_uint(pub.e)}]}
+
+    def sign(claims: dict, kid: str = "kid-1", alg: str = "RS256") -> str:
+        header = mw._b64url_encode(json.dumps({"alg": alg, "kid": kid}).encode())
+        payload = mw._b64url_encode(json.dumps(claims).encode())
+        signing = f"{header}.{payload}".encode()
+        sig = key.sign(signing, padding.PKCS1v15(), hashes.SHA256())
+        return f"{header}.{payload}.{mw._b64url_encode(sig)}"
+
+    return jwks, sign
+
+
+def test_oauth_jwks_rs256():
+    """RS256 JWKS path: kid-matched verification, downgrade rejection, exp,
+    and background key rotation (reference oauth.go:53-140)."""
+    jwks, sign = _make_rsa_jwks()
+    fetches = {"doc": jwks, "count": 0}
+
+    def fetch():
+        fetches["count"] += 1
+        return fetches["doc"]
+
+    keyset = mw.JWKSKeySet("http://test/jwks", refresh_interval_s=0.05,
+                           fetch=fetch)
+    try:
+        assert len(keyset) == 1
+        handler = mw.oauth_jwks_middleware(keyset)(ok)
+        token = sign({"sub": "alice", "exp": time.time() + 60})
+        req = make_request(headers={"Authorization": f"Bearer {token}"})
+        assert handler(req).status == 200
+        assert req.auth_subject == "alice"
+
+        assert handler(make_request()).status == 401          # no token
+        bad = token[:-8] + "AAAAAAAA"                         # corrupt sig
+        assert handler(make_request(
+            headers={"Authorization": f"Bearer {bad}"})).status == 401
+        expired = sign({"sub": "a", "exp": time.time() - 1})
+        assert handler(make_request(
+            headers={"Authorization": f"Bearer {expired}"})).status == 401
+        unknown = sign({"sub": "a", "exp": time.time() + 60}, kid="kid-9")
+        assert handler(make_request(
+            headers={"Authorization": f"Bearer {unknown}"})).status == 401
+        # alg-confusion downgrade: an HS256 token signed with a public
+        # value must never validate on the RS256 path
+        hs = mw.jwt_encode({"sub": "eve", "exp": time.time() + 60}, "n")
+        assert handler(make_request(
+            headers={"Authorization": f"Bearer {hs}"})).status == 401
+        # well-known bypass still open
+        assert handler(make_request(target="/.well-known/alive")).status == 200
+
+        # key rotation: provider replaces its keys; the background refresh
+        # picks them up and old tokens stop validating
+        jwks2, sign2 = _make_rsa_jwks()
+        fetches["doc"] = jwks2
+        deadline = time.time() + 5
+        while keyset.get("kid-1") == (None,) or time.time() < deadline:
+            new_token = sign2({"sub": "bob", "exp": time.time() + 60})
+            resp = handler(make_request(
+                headers={"Authorization": f"Bearer {new_token}"}))
+            if resp.status == 200:
+                break
+            time.sleep(0.05)
+        assert resp.status == 200
+        assert handler(make_request(
+            headers={"Authorization": f"Bearer {token}"})).status == 401
+        assert fetches["count"] >= 2
+    finally:
+        keyset.close()
+
+
+def test_jwks_fetch_failure_keeps_old_keys():
+    jwks, sign = _make_rsa_jwks()
+    state = {"fail": False}
+
+    def fetch():
+        if state["fail"]:
+            raise OSError("endpoint down")
+        return jwks
+
+    keyset = mw.JWKSKeySet("http://test/jwks", refresh_interval_s=3600,
+                           fetch=fetch, logger=MockLogger())
+    try:
+        state["fail"] = True
+        keyset.refresh()  # must not clear the working keys
+        assert len(keyset) == 1
+        token = sign({"sub": "x", "exp": time.time() + 60})
+        assert mw.jwt_decode_rs256(token, keyset)["sub"] == "x"
+    finally:
+        keyset.close()
+
+
 def test_jwt_roundtrip_and_oauth_middleware():
     token = mw.jwt_encode({"sub": "user1", "exp": time.time() + 60}, "s3cr3t")
     claims = mw.jwt_decode(token, "s3cr3t")

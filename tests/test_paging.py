@@ -195,6 +195,39 @@ def test_paged_submit_rejects_impossible_reservation():
         eng.stop()
 
 
+def test_paged_engine_span_and_budget_plan():
+    """The paged engine keeps the base submit(span=) trace surface, and a
+    budget plans with paged=True (no dense growth/ping-pong transient)."""
+    from gofr_tpu.tracing import InMemoryExporter, Tracer
+
+    tracer = Tracer(exporter=InMemoryExporter())
+    params = llama_init(CFG, seed=0)
+    eng = PagedLLMEngine(params, CFG, n_slots=2, max_seq_len=64, page_size=8,
+                         prefill_buckets=(8, 16), logger=MockLogger(),
+                         tracer=tracer, budget_bytes=64 << 20)
+    eng.start()
+    try:
+        assert eng.plan is not None and eng.plan.growth_transient_bytes == 0
+        span = tracer.start_span("req")
+        out = eng.submit([1, 2, 3], max_new_tokens=4, span=span).result(
+            timeout_s=60)
+        assert len(out) == 4
+        assert span.attributes["tpu.prefill_bucket"] == 8
+        assert "batch.id" in span.attributes
+    finally:
+        eng.stop()
+
+
+def test_paged_explicit_pool_must_fit_budget():
+    """An explicit n_pages bypasses the plan's sizing; the constructor must
+    still reject a pool that cannot fit the budget."""
+    params = llama_init(CFG, seed=0)
+    with pytest.raises(ValueError, match="does not fit the budget"):
+        PagedLLMEngine(params, CFG, n_slots=2, max_seq_len=64, page_size=8,
+                       n_pages=100_000, logger=MockLogger(),
+                       budget_bytes=32 << 20)
+
+
 def test_paged_engine_streaming_and_stop_tokens():
     eng = _make_paged()
     try:

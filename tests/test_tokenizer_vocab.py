@@ -1,0 +1,78 @@
+"""Real-vocabulary BPE path: file loading, golden vectors, native parity.
+
+SURVEY §7.5 requires a tokenizer in the serving process; VERDICT r2 item 7
+requires the deployed-vocab path (VOCAB_PATH -> BPETokenizer.from_file) be
+exercised with golden encode vectors, including the C++ merge loop.
+"""
+
+import json
+import os
+
+import pytest
+
+from gofr_tpu import native
+from gofr_tpu.models.tokenizer import BPETokenizer, StreamingDecoder
+
+VOCAB_PATH = os.path.join(os.path.dirname(__file__), "..", "examples",
+                          "llm-server", "vocab.test.json")
+
+
+@pytest.fixture(scope="module")
+def bpe() -> BPETokenizer:
+    return BPETokenizer.from_file(VOCAB_PATH)
+
+
+def test_golden_encode_vectors(bpe):
+    """Pinned outputs for the shipped test vocab: greedy rank-ordered merges
+    collapse to the longest known pieces."""
+    assert bpe.encode("hello world") == [0, 14, 7, 17]       # <s> hello ␣ world
+    assert bpe.encode("hello world", bos=False, eos=True) == [14, 7, 17, 1]
+    assert bpe.encode("held", bos=False) == [11, 18]          # he + ld
+    assert bpe.encode("hell", bos=False) == [13]
+    assert bpe.decode(bpe.encode("hello world")) == "hello world"
+
+
+def test_special_token_surface(bpe):
+    """ByteTokenizer-compatible BOS/EOS so serving code swaps via config."""
+    assert bpe.BOS == 0 and bpe.EOS == 1
+    assert bpe.decode_token(14) == "hello"
+    assert bpe.decode_token(bpe.EOS) == ""
+
+
+def test_native_merge_loop_matches_python(bpe):
+    """The C++ BPECore encode must match the python string-level path
+    token-for-token (same vocab, native disabled)."""
+    if not native.available():
+        pytest.skip("native lib not built")
+    assert bpe._native is not None  # triples were id-representable
+
+    with open(VOCAB_PATH, encoding="utf-8") as fp:
+        data = json.load(fp)
+    python_only = BPETokenizer(data["vocab"], data["merges"])
+    python_only._native = None
+    for text in ("hello world", "held", "hell", "who would", "droll"):
+        assert bpe.encode(text) == python_only.encode(text), text
+
+
+def test_unknown_chars_fall_back_to_python_path(bpe):
+    """Text with chars outside the vocab cannot ride the id-level native
+    loop; the string-level path handles it (unknown chars -> id 0)."""
+    ids = bpe.encode("hexyz", bos=False)
+    assert isinstance(ids, list) and len(ids) >= 1
+
+
+def test_streaming_decoder_piecewise(bpe):
+    """BPE pieces stream as whole strings (no UTF-8 buffering)."""
+    sd = StreamingDecoder(bpe)
+    out = "".join(sd.push(t) for t in bpe.encode("hello world"))
+    assert out == "hello world"  # <s> yields ''
+
+
+def test_from_file_roundtrip(tmp_path):
+    path = tmp_path / "v.json"
+    path.write_text(json.dumps({"vocab": {"a": 0, "b": 1, "ab": 2,
+                                          "<s>": 3, "</s>": 4},
+                                "merges": ["a b"]}))
+    t = BPETokenizer.from_file(str(path))
+    assert t.encode("ab", bos=False) == [2]
+    assert t.vocab_size == 5

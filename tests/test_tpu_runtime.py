@@ -292,6 +292,42 @@ def test_engine_admission_split():
     assert _admission_split(100, 128) == [64, 16, 16, 4]
 
 
+def test_engine_batch_id_trace_correlation():
+    """The engine stamps batch.id/tpu.slot/tpu.prefill_bucket on the
+    request's span at admission and emits tpu.prefill/tpu.decode dispatch
+    spans that close at host sync (SURVEY §5 tracing row)."""
+    from gofr_tpu.models.llama import LlamaConfig, llama_init
+    from gofr_tpu.tpu.engine import LLMEngine
+    from gofr_tpu.tracing import InMemoryExporter, Tracer
+
+    exporter = InMemoryExporter()
+    tracer = Tracer(exporter=exporter)
+    cfg = LlamaConfig.debug()
+    eng = LLMEngine(llama_init(cfg, seed=0), cfg, n_slots=2, max_seq_len=64,
+                    prefill_buckets=(8,), logger=MockLogger(), tracer=tracer)
+    eng.start()
+    try:
+        span = tracer.start_span("POST /generate")
+        req = eng.submit([1, 2, 3], max_new_tokens=4, temperature=0.0,
+                         span=span)
+        req.result(timeout_s=60)
+        span.end()
+    finally:
+        eng.stop()
+
+    assert span.attributes["batch.id"] >= 1
+    assert span.attributes["tpu.slot"] in (0, 1)
+    assert span.attributes["tpu.prefill_bucket"] == 8
+    names = [s.name for s in exporter.spans]
+    assert "tpu.prefill" in names and "tpu.decode" in names
+    prefill = next(s for s in exporter.spans if s.name == "tpu.prefill")
+    assert prefill.attributes["batch.id"] == span.attributes["batch.id"]
+    assert prefill.attributes["batch.size"] == 1
+    assert prefill.end_time is not None  # closed at host sync
+    decode = next(s for s in exporter.spans if s.name == "tpu.decode")
+    assert decode.attributes["tpu.block"] == eng.decode_block_size
+
+
 def test_engine_flash_prefill_matches_xla():
     """attn_impl="flash" routes serving prefill through the Pallas kernel
     (full-window T == S case); greedy tokens must match the dense path."""
