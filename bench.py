@@ -231,6 +231,17 @@ def main() -> None:
     rng = np.random.default_rng(0)
     params = llama_init(cfg, seed=0)
 
+    from gofr_tpu.tpu.executor import Executor
+
+    # persist compiled programs across bench runs: a fresh (bucket x K)
+    # prefill variant compiling MID-PHASE stalls every active request for
+    # the full remote-compile latency — the dominant tail-TTFT term on the
+    # tunneled backend. The disk cache amortizes it to the first run.
+    cache_dir = os.environ.get("BENCH_PROGRAM_CACHE",
+                               os.path.join(os.path.dirname(
+                                   os.path.abspath(__file__)),
+                                   ".bench_programs"))
+
     def make_engine(slots, seq, use_cfg):
         # block/depth from a sweep on v5e: small blocks turn finished slots
         # over faster; depth 2 hides dispatch latency without inflating the
@@ -239,7 +250,8 @@ def main() -> None:
                         prefill_buckets=tuple(b for b in prefill_buckets
                                               if b <= seq),
                         decode_block_size=8, pipeline_depth=2, seed=0,
-                        budget_bytes=budget or None)
+                        budget_bytes=budget or None,
+                        executor=Executor(cache_dir=cache_dir or None))
         eng.start()
         try:
             # grow=False: T0 must run at the small boot-time allocation (the

@@ -64,7 +64,8 @@ def build_engine(app: App) -> LLMEngine:
         max_seq_len=app.config.get_int("MAX_SEQ_LEN", 1024),
         prefill_buckets=tuple(int(b) for b in app.config.get_or_default(
             "PREFILL_BUCKETS", "16,32,64,128,256").split(",")),
-        executor=Executor(tpu),
+        executor=Executor(tpu, cache_dir=app.config.get_or_default(
+            "PROGRAM_CACHE_DIR", "") or None),
         metrics=app.container.metrics_manager,
         logger=app.logger,
         mesh=mesh,

@@ -47,7 +47,6 @@ class _SqliteDriver:
 
     name = "sqlite"
     paramstyle = "qmark"
-    errors = (sqlite3.Error,)
 
     def __init__(self, config):
         self.path = config.get_or_default(
@@ -77,7 +76,6 @@ class _NetworkDriver:
     returning dict rows, TCP connect params from config."""
 
     paramstyle = "format"
-    errors = (Exception,)
 
     def __init__(self, config, module):
         self.module = module
@@ -92,7 +90,11 @@ class _NetworkDriver:
 
     def execute(self, conn, query: str, args: Sequence[Any]):
         cursor = conn.cursor()
-        cursor.execute(_to_format_bindvars(query), tuple(args))
+        if args:
+            cursor.execute(_to_format_bindvars(query), tuple(args))
+        else:
+            # no params -> no %-interpolation pass; literal % stays as-is
+            cursor.execute(query)
         return cursor
 
     def fetchall(self, cursor) -> List[Any]:
@@ -129,12 +131,19 @@ class _PostgresDriver(_NetworkDriver):
 
 
 def _to_format_bindvars(query: str) -> str:
-    """qmark -> format placeholders, skipping quoted literals (bind.go)."""
+    """qmark -> format placeholders, skipping quoted literals (bind.go).
+
+    Literal '%' doubles to '%%' EVERYWHERE (including inside string
+    literals): DB-API format-paramstyle drivers %-interpolate the whole
+    statement when args are passed, so `LIKE 'a%'` would otherwise raise
+    'unsupported format character'."""
     out, in_str = [], False
     for ch in query:
         if ch == "'":
             in_str = not in_str
             out.append(ch)
+        elif ch == "%":
+            out.append("%%")
         elif ch == "?" and not in_str:
             out.append("%s")
         else:

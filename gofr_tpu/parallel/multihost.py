@@ -10,10 +10,13 @@ reference reaches other processes through its service client
 (service/new.go:68-87) rather than a bespoke transport.
 
 Config keys (configs/.env):
-  JAX_COORDINATOR_ADDR  host:port of process 0 (required to enable)
-  JAX_NUM_PROCESSES     world size
-  JAX_PROCESS_ID        this process's rank
-  JAX_LOCAL_DEVICE_IDS  optional comma list restricting local devices
+  JAX_COORDINATOR_ADDR       host:port of process 0 (required to enable)
+  JAX_NUM_PROCESSES          world size
+  JAX_PROCESS_ID             this process's rank
+  JAX_LOCAL_DEVICE_IDS       optional comma list restricting local devices
+  JAX_COORDINATOR_TIMEOUT_S  optional bound on the coordinator handshake —
+                             a bad coordinator address fails boot LOUDLY
+                             after this many seconds instead of hanging
 
 Single-process use needs none of these — `initialize_from_config` is a
 no-op without JAX_COORDINATOR_ADDR, so the same binary runs a laptop, one
@@ -32,6 +35,7 @@ class MultiHostSpec:
     num_processes: int
     process_id: int
     local_device_ids: Optional[List[int]] = None
+    timeout_s: Optional[float] = None
 
     @classmethod
     def from_config(cls, config) -> Optional["MultiHostSpec"]:
@@ -46,8 +50,10 @@ class MultiHostSpec:
                              f"JAX_NUM_PROCESSES {num}")
         raw_ids = config.get_or_default("JAX_LOCAL_DEVICE_IDS", "")
         ids = [int(x) for x in raw_ids.split(",") if x.strip()] or None
+        raw_timeout = config.get_or_default("JAX_COORDINATOR_TIMEOUT_S", "")
+        timeout = float(raw_timeout) if raw_timeout else None
         return cls(coordinator=coordinator, num_processes=num,
-                   process_id=pid, local_device_ids=ids)
+                   process_id=pid, local_device_ids=ids, timeout_s=timeout)
 
 
 def initialize_from_config(config, logger=None) -> Optional[MultiHostSpec]:
@@ -62,11 +68,14 @@ def initialize_from_config(config, logger=None) -> Optional[MultiHostSpec]:
         return None
     import jax
 
+    kwargs = {}
+    if spec.timeout_s is not None:
+        kwargs["initialization_timeout"] = int(spec.timeout_s)
     jax.distributed.initialize(
         coordinator_address=spec.coordinator,
         num_processes=spec.num_processes,
         process_id=spec.process_id,
-        local_device_ids=spec.local_device_ids)
+        local_device_ids=spec.local_device_ids, **kwargs)
     if logger is not None:
         logger.infof("joined multi-host job: rank %d/%d via %s",
                      spec.process_id, spec.num_processes, spec.coordinator)

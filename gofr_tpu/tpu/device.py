@@ -81,6 +81,7 @@ class TPUClient:
         for name, desc in (
             ("app_tpu_compile_total", "XLA compilations performed"),
             ("app_tpu_compile_cache_hits", "executor compile-cache hits"),
+            ("app_tpu_compile_disk_hits", "programs loaded from the disk cache"),
             ("app_tpu_execute_total", "device executions dispatched"),
             ("app_tpu_tokens_generated_total", "output tokens generated"),
             ("app_tpu_requests_total", "inference requests admitted"),

@@ -67,10 +67,14 @@ class GenerationRequest:
         self.max_new_tokens = max_new_tokens
         self.temperature = float(temperature)
         self.stop_tokens = stop_tokens or set()
-        # the caller's trace span: the engine stamps batch.id/tpu.slot/
-        # tpu.prefill_bucket on it at admission so one request's trace
-        # covers its slot in the fused batch (SURVEY §5 tracing row)
+        # the caller's trace span: batch.id/tpu.slot/tpu.prefill_bucket are
+        # stamped on it at admission (SURVEY §5 tracing row). For STREAMED
+        # responses the HTTP middleware ends this span before admission, so
+        # the engine also opens a child "tpu.generate" span (gen_span) that
+        # lives from submit to finish and carries the same attributes —
+        # exported reliably regardless of when the parent closed.
         self.span = span
+        self.gen_span = None
         self.out_queue: "queue.Queue" = queue.Queue()
         self.cancelled = threading.Event()
         self.error: Optional[BaseException] = None
@@ -372,6 +376,11 @@ class LLMEngine:
                              f"admission limit ({limit})")
         request = GenerationRequest(prompt_tokens, max_new_tokens, temperature,
                                     stop_tokens, span=span)
+        if self.tracer is not None:
+            request.gen_span = self.tracer.start_span("tpu.generate",
+                                                      parent=span)
+            request.gen_span.set_attribute("tpu.prompt_tokens",
+                                           len(request.prompt_tokens))
         self._obs.counter("app_tpu_requests_total")
         self._pending.put(request)
         if self._stop.is_set():
@@ -587,7 +596,7 @@ class LLMEngine:
             if request.cancelled.is_set():
                 self._deferred.popleft()
                 self._abort_admission(request)
-                request.out_queue.put(None)
+                self._fail_request(request)
                 continue
             if not self._admission_ready(request):
                 break
@@ -599,7 +608,7 @@ class LLMEngine:
             except queue.Empty:
                 break
             if request.cancelled.is_set():
-                request.out_queue.put(None)
+                self._fail_request(request)
                 continue
             if not self._admission_ready(request):
                 self._deferred.append(request)
@@ -639,8 +648,7 @@ class LLMEngine:
                                 len(batch), exc)
                         for request in batch:
                             self._abort_admission(request)
-                            request.error = exc
-                            request.out_queue.put(None)
+                            self._fail_request(request, exc)
                         continue
                     dispatched.update(r.id for r in batch)
         except Exception as exc:
@@ -649,8 +657,7 @@ class LLMEngine:
             for request in taken:
                 if request.id not in dispatched:
                     self._abort_admission(request)
-                    request.error = exc
-                    request.out_queue.put(None)
+                    self._fail_request(request, exc)
             raise
 
         self._obs.gauge("app_tpu_queue_depth", self._pending.qsize())
@@ -702,10 +709,11 @@ class LLMEngine:
             # first sampled token is written at `length` by the next decode
             slot.length = len(request.prompt_tokens)
             slot.remaining = request.max_new_tokens - 1
-            if request.span is not None:
-                request.span.set_attribute("batch.id", batch_id)
-                request.span.set_attribute("tpu.slot", slots_idx[row])
-                request.span.set_attribute("tpu.prefill_bucket", bucket)
+            for span in (request.span, request.gen_span):
+                if span is not None:
+                    span.set_attribute("batch.id", batch_id)
+                    span.set_attribute("tpu.slot", slots_idx[row])
+                    span.set_attribute("tpu.prefill_bucket", bucket)
             admitted.append((slots_idx[row], request))
         self._inflight.append(("prefill", first, admitted, dspan))
 
@@ -828,6 +836,20 @@ class LLMEngine:
         self._obs.hist("app_tpu_batch_size", n_active)
         self._track_throughput(emitted)
 
+    def _fail_request(self, request: GenerationRequest,
+                      exc: Optional[BaseException] = None) -> None:
+        """Terminate a request that never reached (or lost) a slot: close
+        its generation span and unblock its consumer."""
+        if exc is not None:
+            request.error = exc
+        if request.gen_span is not None and request.gen_span.end_time is None:
+            if request.error is not None:
+                request.gen_span.set_status(False, str(request.error))
+            elif request.cancelled.is_set():
+                request.gen_span.set_attribute("cancelled", True)
+            request.gen_span.end()
+        request.out_queue.put(None)
+
     def _emit(self, request: GenerationRequest, token: int) -> None:
         request.generated += 1
         request.out_queue.put(token)
@@ -840,6 +862,11 @@ class LLMEngine:
         slot.remaining = 0
         if request is not None:
             request.finished_at = time.time()
+            if request.gen_span is not None:
+                request.gen_span.set_attribute("tpu.tokens", request.generated)
+                if request.error is not None:
+                    request.gen_span.set_status(False, str(request.error))
+                request.gen_span.end()
             request.out_queue.put(None)
         self._obs.gauge("app_tpu_active_slots",
                             sum(1 for s in self.slots if s.active))
@@ -849,6 +876,13 @@ class LLMEngine:
         (donation means the old buffers may be deleted on TPU/GPU) and fail
         every active request, whose cached context no longer exists."""
         with self._state_lock:
+            # close the dispatch spans of everything in flight — the trace
+            # record matters MOST for the window a device error destroyed
+            for entry in self._inflight:
+                dspan = entry[3] if entry[0] == "prefill" else entry[5]
+                if dspan is not None:
+                    dspan.set_status(False, str(exc))
+                    dspan.end()
             self._inflight.clear()
             for slot in self.slots:
                 if slot.active:
@@ -869,15 +903,13 @@ class LLMEngine:
         while self._deferred:
             request = self._deferred.popleft()
             self._abort_admission(request)
-            request.error = exc
-            request.out_queue.put(None)
+            self._fail_request(request, exc)
         while True:
             try:
                 request = self._pending.get_nowait()
             except queue.Empty:
                 return
-            request.error = exc
-            request.out_queue.put(None)
+            self._fail_request(request, exc)
 
     def _track_throughput(self, tokens: int) -> None:
         now = time.time()

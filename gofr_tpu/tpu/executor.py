@@ -9,6 +9,9 @@ programs. The cache is the analog of the reference keeping its expensive init
 
 from __future__ import annotations
 
+import hashlib
+import os
+import pickle
 import threading
 import time
 from typing import Any, Callable, Dict, Optional, Sequence, Tuple
@@ -74,12 +77,20 @@ class Executor:
     one-call convenience: bucket -> compile-or-hit -> execute.
     """
 
-    def __init__(self, tpu_client=None, logger=None, metrics=None):
+    def __init__(self, tpu_client=None, logger=None, metrics=None,
+                 cache_dir: Optional[str] = None):
         self.tpu = tpu_client
         self.logger = logger if logger is not None else getattr(tpu_client, "logger", None)
         self.metrics = metrics if metrics is not None else getattr(tpu_client, "metrics", None)
         self._cache: Dict[Tuple, CompiledProgram] = {}
         self._lock = threading.Lock()
+        # compiled-program persistence (SURVEY §2.5 item 2): serialized PJRT
+        # executables keyed by (program, shapes, backend); a second boot
+        # loads them instead of re-tracing + re-compiling
+        self.cache_dir = cache_dir
+        self.disk_hits = 0
+        if cache_dir:
+            os.makedirs(cache_dir, exist_ok=True)
 
     def _observe_compile(self, name: str, seconds: float, hit: bool) -> None:
         if self.metrics is not None:
@@ -92,6 +103,104 @@ class Executor:
                 pass
         if not hit and self.logger is not None:
             self.logger.infof("compiled %s in %.2fs", name, seconds)
+
+    def _disk_path(self, key: Tuple, fn: Callable) -> Optional[str]:
+        if not self.cache_dir:
+            return None
+        import jax
+
+        try:
+            device = jax.devices()[0]
+            # the full marshalled code object (bytecode + consts + names +
+            # nested code) AND the closure cell values go into the
+            # fingerprint: co_code alone is identical for `x+1` vs `x+2`
+            # (constants live in co_consts), and engine program factories
+            # close over the model config — neither may resurrect a stale
+            # executable. Address-bearing reprs (plain objects) are reduced
+            # to their type name so the digest is stable across processes.
+            import marshal
+            import re
+
+            code = getattr(fn, "__code__", None)
+            code_bytes = marshal.dumps(code) if code is not None else b""
+            cells = []
+            for cell in (getattr(fn, "__closure__", None) or ()):
+                try:
+                    text = repr(cell.cell_contents)
+                except Exception:  # noqa: BLE001
+                    text = "?"
+                if " at 0x" in text:
+                    text = type(cell.cell_contents).__name__
+                cells.append(re.sub(r"0x[0-9a-f]+", "", text))
+            fingerprint = (key, jax.__version__, device.platform,
+                           device.device_kind,
+                           hashlib.sha256(code_bytes).hexdigest(),
+                           tuple(cells))
+        except Exception:  # noqa: BLE001
+            return None
+        digest = hashlib.sha256(repr(fingerprint).encode()).hexdigest()[:32]
+        return os.path.join(self.cache_dir, f"{digest}.jexec")
+
+    def _load_from_disk(self, name: str, key: Tuple,
+                        fn: Callable) -> Optional[CompiledProgram]:
+        path = self._disk_path(key, fn)
+        if path is None or not os.path.exists(path):
+            return None
+        import jax
+        from jax.experimental import serialize_executable
+
+        try:
+            with open(path, "rb") as fp:
+                blob, in_tree, out_tree = pickle.load(fp)
+            # persisted programs are single-device (see _save_to_disk);
+            # pinning execution_devices keeps the load correct when the
+            # process exposes a wider device set (virtual CPU meshes)
+            compiled = serialize_executable.deserialize_and_load(
+                blob, in_tree, out_tree,
+                execution_devices=jax.devices()[:1])
+        except Exception as exc:  # noqa: BLE001 - stale/foreign artifact
+            if self.logger is not None:
+                self.logger.warnf("discarding persisted program %s: %s",
+                                  path, exc)
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+            return None
+        if self.metrics is not None:
+            try:
+                self.metrics.increment_counter("app_tpu_compile_disk_hits")
+            except Exception:  # noqa: BLE001
+                pass
+        self.disk_hits += 1
+        if self.logger is not None:
+            self.logger.infof("loaded %s from program cache", name)
+        return CompiledProgram(compiled, name, key)
+
+    def _save_to_disk(self, key: Tuple, fn: Callable, compiled) -> None:
+        path = self._disk_path(key, fn)
+        if path is None:
+            return
+        import jax
+        from jax.experimental import serialize_executable
+
+        try:
+            devices = set()
+            for s in jax.tree_util.tree_leaves(compiled.input_shardings):
+                devices |= getattr(s, "device_set", set())
+            if len(devices) > 1:
+                # multi-device (mesh) programs are not persisted: their
+                # device ORDER cannot be reconstructed from a device count,
+                # and a wrong assignment would silently mis-shard
+                return
+            payload = pickle.dumps(serialize_executable.serialize(compiled))
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "wb") as fp:
+                fp.write(payload)
+            os.replace(tmp, path)
+        except Exception as exc:  # noqa: BLE001 - persistence is best-effort
+            if self.logger is not None:
+                self.logger.debugf("could not persist program: %s", exc)
 
     def compile(self, name: str, fn: Callable, args: Tuple,
                 static_argnums: Tuple[int, ...] = (),
@@ -107,6 +216,12 @@ class Executor:
             self._observe_compile(name, 0.0, hit=True)
             return cached
 
+        loaded = self._load_from_disk(name, key, fn)
+        if loaded is not None:
+            with self._lock:
+                loaded = self._cache.setdefault(key, loaded)
+            return loaded
+
         start = time.time()
         kwargs: Dict[str, Any] = {}
         if static_argnums:
@@ -121,6 +236,7 @@ class Executor:
         compiled = jitted.lower(*args).compile()
         program = CompiledProgram(compiled, name, key)
         elapsed = time.time() - start
+        self._save_to_disk(key, fn, compiled)
         with self._lock:
             # a racing thread may have compiled the same key; keep the first
             program = self._cache.setdefault(key, program)

@@ -125,6 +125,23 @@ def test_mysql_adapter_connects_and_translates_bindvars(fake_mysql):
     assert db.query_row("SELECT * FROM t")["id"] == 1
 
 
+def test_mysql_percent_literals_survive_interpolation(fake_mysql):
+    """Literal % (LIKE patterns) must be escaped to %% when args are
+    interpolated, and left untouched when there are no args."""
+    from gofr_tpu.datasource.sql import SQL, _to_format_bindvars
+
+    assert (_to_format_bindvars("SELECT * FROM t WHERE n LIKE 'a%' AND id = ?")
+            == "SELECT * FROM t WHERE n LIKE 'a%%' AND id = %s")
+    conns, _ = fake_mysql
+    db = SQL(_mysql_config(), MockLogger(), None, background=False)
+    db.query("SELECT * FROM t WHERE n LIKE 'a%' AND id = ?", 1)
+    assert conns[0].executed[-1][0] == \
+        "SELECT * FROM t WHERE n LIKE 'a%%' AND id = %s"
+    # no args -> no interpolation pass -> raw query untouched
+    db.query("SELECT * FROM t WHERE n LIKE 'a%'")
+    assert conns[0].executed[-1] == ("SELECT * FROM t WHERE n LIKE 'a%'", ())
+
+
 def test_mysql_health_and_ping_redial(fake_mysql):
     from gofr_tpu.datasource.sql import SQL
 

@@ -4,6 +4,7 @@ Runs on the virtual CPU backend (conftest) — real compile/execute semantics,
 no hardware, per SURVEY.md §4's fake-backend lesson.
 """
 
+import os
 import threading
 import time
 
@@ -99,6 +100,69 @@ def test_executor_donation():
 
 
 # -- dynamic batcher ----------------------------------------------------------
+def test_executor_disk_cache_skips_recompile(tmp_path):
+    """A second executor (fresh process analog) loads the persisted PJRT
+    executable instead of recompiling (SURVEY §2.5 item 2)."""
+    import jax.numpy as jnp
+
+    def double(x):
+        return x * 2 + 1
+
+    cache = str(tmp_path / "programs")
+    args = (jnp.ones((8,)),)
+    ex1 = Executor(cache_dir=cache)
+    p1 = ex1.compile("double", double, args)
+    assert ex1.disk_hits == 0
+    files = list(os.listdir(cache))
+    assert len(files) == 1 and files[0].endswith(".jexec")
+
+    ex2 = Executor(cache_dir=cache)  # no in-memory state
+    p2 = ex2.compile("double", double, args)
+    assert ex2.disk_hits == 1  # boot skipped the recompile
+    np.testing.assert_array_equal(np.asarray(p2(*args)), np.asarray(p1(*args)))
+    # in-memory cache serves the next request, not the disk
+    ex2.compile("double", double, args)
+    assert ex2.disk_hits == 1
+
+    # a changed function body with the SAME name+shapes must NOT resurrect
+    # the stale executable — including a CONSTANT-only change (identical
+    # co_code; only co_consts differs) and a closure-value change, the two
+    # edits a bytecode-only fingerprint would miss
+    def double_v2(x):
+        return x * 2 + 2
+
+    ex3 = Executor(cache_dir=cache)
+    p3 = ex3.compile("double", double_v2, args)
+    assert ex3.disk_hits == 0
+    assert float(np.asarray(p3(*args))[0]) == 4.0
+
+    def make_scaler(c):
+        def scaler(x):
+            return x * c
+        return scaler
+
+    exc1 = Executor(cache_dir=cache)
+    pc1 = exc1.compile("scale", make_scaler(3.0), args)
+    assert float(np.asarray(pc1(*args))[0]) == 3.0
+    exc2 = Executor(cache_dir=cache)
+    pc2 = exc2.compile("scale", make_scaler(5.0), args)  # same code, new cell
+    assert exc2.disk_hits == 0
+    assert float(np.asarray(pc2(*args))[0]) == 5.0
+    exc3 = Executor(cache_dir=cache)  # same closure value -> disk hit
+    pc3 = exc3.compile("scale", make_scaler(5.0), args)
+    assert exc3.disk_hits == 1
+    assert float(np.asarray(pc3(*args))[0]) == 5.0
+
+    # corrupted artifact: fall back to compiling, quarantine the file
+    bad = os.path.join(cache, files[0])
+    with open(bad, "wb") as fp:
+        fp.write(b"garbage")
+    ex4 = Executor(cache_dir=cache)
+    p4 = ex4.compile("double", double, args)
+    assert ex4.disk_hits == 0
+    assert float(np.asarray(p4(*args))[0]) == 3.0
+
+
 def test_batcher_batches_and_demuxes():
     metrics, client = make_metrics()
     ex = Executor(client)
@@ -326,6 +390,15 @@ def test_engine_batch_id_trace_correlation():
     assert prefill.end_time is not None  # closed at host sync
     decode = next(s for s in exporter.spans if s.name == "tpu.decode")
     assert decode.attributes["tpu.block"] == eng.decode_block_size
+    # the per-request child span carries the correlation EXPORTED — for
+    # streamed responses the parent HTTP span ends before admission, so the
+    # child is the reliable record
+    gen = next(s for s in exporter.spans if s.name == "tpu.generate")
+    assert gen.parent_id == span.span_id
+    assert gen.attributes["batch.id"] == span.attributes["batch.id"]
+    assert gen.attributes["tpu.prompt_tokens"] == 3
+    assert gen.attributes["tpu.tokens"] == 4
+    assert gen.end_time is not None
 
 
 def test_engine_flash_prefill_matches_xla():
