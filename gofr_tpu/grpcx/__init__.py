@@ -4,12 +4,13 @@ Parity: reference pkg/gofr/grpc.go:20-46 (grpc.Server on GRPC_PORT, started
 only when a service is registered) and pkg/gofr/grpc/log.go:58-94 (interceptor
 opening a span and emitting an RPCLog per call).
 
-protoc's Python gRPC plugin is not available in this environment, so services
-register via `GenericService`: a (service_name, {method: handler}) pair using
-pluggable serializers (default JSON bytes). Handlers receive a Context whose
-request carries the deserialized message — the same handler shape as HTTP.
-Stubs generated elsewhere also work: any object exposing
-`__grpc_service_name__` and `__grpc_methods__` registers identically.
+Services register via `GenericService`: a (service_name, {method: handler})
+pair with pluggable serializers. Default is JSON bytes; passing a
+protoc-generated Message's SerializeToString/FromString speaks the real
+protobuf wire format (exercised end-to-end in tests/test_grpc_proto.py with
+protoc-generated stubs). Handlers receive a Context whose request carries
+the deserialized message — the same handler shape as HTTP. Objects exposing
+`__grpc_service_name__` and `__grpc_methods__` register identically.
 """
 
 from __future__ import annotations
@@ -151,7 +152,9 @@ class GRPCServer:
 
 
 class GRPCClient:
-    """Counterpart client for GenericService endpoints (JSON-over-gRPC)."""
+    """Counterpart client for GenericService endpoints. JSON by default;
+    pass protobuf Message serializers (SerializeToString/FromString) to
+    speak the binary wire format of protoc-generated stubs."""
 
     def __init__(self, address: str):
         import grpc
@@ -160,11 +163,15 @@ class GRPCClient:
         self.channel = grpc.insecure_channel(address)
 
     def call(self, service: str, method: str, payload: Any, timeout_s: float = 5.0,
-             metadata: Optional[Dict[str, str]] = None) -> Any:
+             metadata: Optional[Dict[str, str]] = None,
+             serializer: Optional[Callable[[Any], bytes]] = None,
+             deserializer: Optional[Callable[[bytes], Any]] = None) -> Any:
         fn = self.channel.unary_unary(
             f"/{service}/{method}",
-            request_serializer=lambda obj: json.dumps(obj, default=str).encode(),
-            response_deserializer=lambda raw: json.loads(raw.decode()) if raw else None,
+            request_serializer=serializer or (
+                lambda obj: json.dumps(obj, default=str).encode()),
+            response_deserializer=deserializer or (
+                lambda raw: json.loads(raw.decode()) if raw else None),
         )
         md = list((metadata or {}).items())
         return fn(payload, timeout=timeout_s, metadata=md)

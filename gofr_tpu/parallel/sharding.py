@@ -83,9 +83,16 @@ def serving_param_specs() -> Dict[str, Any]:
 
 
 def kv_cache_spec():
-    """KV cache [L, B, Hkv, dh, S]: KV heads shard over tp, matching the
-    column split of wk/wv so each shard writes and reads only its heads."""
+    """Stacked KV cache/pool [L, B|P, Hkv, dh, S]: KV heads shard over tp,
+    matching the column split of wk/wv so each shard writes and reads only
+    its heads."""
     return _P(None, None, "tp", None, None)
+
+
+def kv_cache_layer_spec():
+    """One per-layer cache buffer [B, Hkv, dh, S] (the dense engine's
+    representation, init_kv_cache_layers): KV heads over tp."""
+    return _P(None, "tp", None, None)
 
 
 def batch_spec():

@@ -122,9 +122,17 @@ class HTTPExporter(Exporter):
         self._lock = threading.Lock()
         self._last_flush = time.time()
 
+    def _span_payload(self, span: Span) -> Dict[str, Any]:
+        """Wire shape of one span; subclasses override for zipkin/OTLP."""
+        return span.to_dict()
+
+    def _wrap_batch(self, batch: List[Dict[str, Any]]) -> Any:
+        """Top-level request body; subclasses override (OTLP envelopes)."""
+        return batch
+
     def export(self, span: Span) -> None:
         with self._lock:
-            self._buf.append(span.to_dict())
+            self._buf.append(self._span_payload(span))
             should = len(self._buf) >= self.batch_size or (time.time() - self._last_flush) > self.flush_interval_s
             if not should:
                 return
@@ -133,11 +141,79 @@ class HTTPExporter(Exporter):
         try:
             import requests
 
-            requests.post(self.url, data=json.dumps(batch),
+            requests.post(self.url, data=json.dumps(self._wrap_batch(batch)),
                           headers={"Content-Type": "application/json"}, timeout=2)
         except Exception as exc:  # noqa: BLE001 - exporting is best-effort
             if self.logger is not None:
                 self.logger.debugf("trace export failed: %s", exc)
+
+
+class ZipkinExporter(HTTPExporter):
+    """Zipkin v2 JSON wire format (POST /api/v2/spans) — the reference's
+    zipkin exporter option (gofr.go:281-313). Shares the HTTPExporter's
+    batch/flush machinery; only the payload shape differs."""
+
+    def __init__(self, url: str, service_name: str = "gofr-tpu", **kw):
+        super().__init__(url, **kw)
+        self.service_name = service_name
+
+    def _span_payload(self, span: Span) -> Dict[str, Any]:
+        out = {
+            "traceId": span.trace_id,
+            "id": span.span_id,
+            "name": span.name,
+            "timestamp": int(span.start_time * 1e6),       # microseconds
+            "duration": max(1, int(((span.end_time or span.start_time)
+                                    - span.start_time) * 1e6)),
+            "localEndpoint": {"serviceName": self.service_name},
+            "tags": {k: str(v) for k, v in span.attributes.items()},
+        }
+        if span.parent_id:
+            out["parentId"] = span.parent_id
+        if not span.status_ok:
+            out["tags"]["error"] = span.status_message or "error"
+        return out
+
+
+class OTLPHTTPExporter(HTTPExporter):
+    """OTLP/HTTP JSON wire format (POST /v1/traces) — the reference's
+    jaeger/OTLP exporter option (gofr.go:281-313 uses otlptracegrpc; the
+    JSON-over-HTTP encoding is the driverless equivalent)."""
+
+    def __init__(self, url: str, service_name: str = "gofr-tpu", **kw):
+        super().__init__(url, **kw)
+        self.service_name = service_name
+
+    def _span_payload(self, span: Span) -> Dict[str, Any]:
+        def attr(key, value):
+            if isinstance(value, bool):
+                return {"key": key, "value": {"boolValue": value}}
+            if isinstance(value, int):
+                return {"key": key, "value": {"intValue": str(value)}}
+            if isinstance(value, float):
+                return {"key": key, "value": {"doubleValue": value}}
+            return {"key": key, "value": {"stringValue": str(value)}}
+
+        return {
+            "traceId": span.trace_id,
+            "spanId": span.span_id,
+            "parentSpanId": span.parent_id or "",
+            "name": span.name,
+            "kind": 2,  # SPAN_KIND_SERVER
+            "startTimeUnixNano": str(int(span.start_time * 1e9)),
+            "endTimeUnixNano": str(int((span.end_time or span.start_time) * 1e9)),
+            "attributes": [attr(k, v) for k, v in span.attributes.items()],
+            "status": ({"code": 1} if span.status_ok
+                       else {"code": 2, "message": span.status_message}),
+        }
+
+    def _wrap_batch(self, batch: List[Dict[str, Any]]) -> Any:
+        return {"resourceSpans": [{
+            "resource": {"attributes": [
+                {"key": "service.name",
+                 "value": {"stringValue": self.service_name}}]},
+            "scopeSpans": [{"scope": {"name": "gofr_tpu"}, "spans": batch}],
+        }]}
 
 
 class Tracer:
@@ -177,13 +253,20 @@ def parse_traceparent(header: str) -> Optional[tuple]:
 
 def exporter_from_config(config, logger) -> Exporter:
     """Select exporter via TRACE_EXPORTER like gofr.go:281-313 selects
-    jaeger/zipkin/gofr. Here: 'log', 'http' (TRACER_URL), 'memory', default noop."""
+    jaeger/zipkin/gofr: 'zipkin' (v2 JSON), 'jaeger'/'otlp' (OTLP/HTTP
+    JSON), 'http'/'gofr' (plain JSON batches), 'log', 'memory'; default
+    noop. Network exporters need TRACER_URL."""
     name = (config.get_or_default("TRACE_EXPORTER", "") or "").lower()
     if name == "log":
         return LogExporter(logger)
-    if name in ("http", "gofr", "zipkin", "jaeger"):
+    if name in ("http", "gofr", "zipkin", "jaeger", "otlp"):
         url = config.get_or_default("TRACER_URL", "")
+        service = config.get_or_default("APP_NAME", "gofr-tpu")
         if url:
+            if name == "zipkin":
+                return ZipkinExporter(url, service_name=service, logger=logger)
+            if name in ("jaeger", "otlp"):
+                return OTLPHTTPExporter(url, service_name=service, logger=logger)
             return HTTPExporter(url, logger=logger)
         logger.warn("TRACE_EXPORTER set but TRACER_URL missing; tracing disabled")
     if name == "memory":

@@ -1,0 +1,89 @@
+"""gRPC over REAL protobuf wire format, with protoc-generated stubs.
+
+The reference registers protoc-generated service stubs (grpc.go:56-60,
+examples/grpc-server). Here protoc generates the message classes AT TEST
+TIME (the binary is in the image) and the GenericService speaks their
+binary encoding via SerializeToString/FromString — proving the server's
+serializer plumbing carries protobuf, not just the JSON default.
+"""
+
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+from gofr_tpu.grpcx import GenericService, GRPCClient, GRPCServer
+from gofr_tpu.logging import MockLogger
+
+PROTO = """
+syntax = "proto3";
+package gofrtest;
+message EmbedRequest { string text = 1; int32 id = 2; }
+message EmbedResponse { repeated float vector = 1; int32 id = 2; }
+"""
+
+
+@pytest.fixture(scope="module")
+def embed_pb2(tmp_path_factory):
+    if shutil.which("protoc") is None:
+        pytest.skip("protoc not available")
+    root = tmp_path_factory.mktemp("proto")
+    (root / "embed.proto").write_text(PROTO)
+    subprocess.run(["protoc", f"--python_out={root}", "embed.proto"],
+                   cwd=root, check=True)
+    sys.path.insert(0, str(root))
+    try:
+        import embed_pb2 as module
+
+        yield module
+    finally:
+        sys.path.remove(str(root))
+
+
+class _Container:
+    def __init__(self):
+        self.logger = MockLogger()
+        self.tracer = None
+        self.metrics_manager = None
+
+    def __getattr__(self, name):
+        return None
+
+
+def test_protobuf_stub_round_trip(embed_pb2):
+    def embed(ctx):
+        msg = ctx.request.payload                    # deserialized Message
+        assert isinstance(msg, embed_pb2.EmbedRequest)
+        return embed_pb2.EmbedResponse(
+            vector=[float(len(msg.text)), 2.5], id=msg.id)
+
+    service = GenericService(
+        "gofrtest.Embedder", {"Embed": embed},
+        serializer=lambda msg: msg.SerializeToString(),
+        deserializer=embed_pb2.EmbedRequest.FromString)
+
+    server = GRPCServer(_Container(), port=0, logger=MockLogger())
+    server.register(service)
+    server.start()
+    try:
+        client = GRPCClient(f"127.0.0.1:{server.port}")
+        resp = client.call(
+            "gofrtest.Embedder", "Embed",
+            embed_pb2.EmbedRequest(text="hello", id=9),
+            serializer=lambda msg: msg.SerializeToString(),
+            deserializer=embed_pb2.EmbedResponse.FromString)
+        assert isinstance(resp, embed_pb2.EmbedResponse)
+        assert resp.id == 9
+        assert list(resp.vector) == [5.0, 2.5]
+        client.close()
+    finally:
+        server.stop()
+
+
+def test_protobuf_wire_bytes_are_binary(embed_pb2):
+    """The wire payload is protobuf binary, not JSON in disguise."""
+    raw = embed_pb2.EmbedRequest(text="hi", id=3).SerializeToString()
+    assert raw and not raw.strip().startswith(b"{")
+    parsed = embed_pb2.EmbedRequest.FromString(raw)
+    assert parsed.text == "hi" and parsed.id == 3

@@ -58,9 +58,10 @@ def test_tp_cache_is_sharded_over_kv_heads():
     params = llama_init(CFG, seed=0)
     eng = LLMEngine(params, CFG, n_slots=2, max_seq_len=64,
                     prefill_buckets=(8,), mesh=mesh)
-    # [L, B, Hkv, dh, S]: each device holds half the KV heads
-    shard_shape = eng.k_cache.sharding.shard_shape(eng.k_cache.shape)
-    assert shard_shape[2] == CFG.n_kv_heads // 2
+    # per-layer [B, Hkv, dh, S] buffers: each device holds half the KV heads
+    k0 = eng.k_cache[0]
+    shard_shape = k0.sharding.shard_shape(k0.shape)
+    assert shard_shape[1] == CFG.n_kv_heads // 2
     # params: wq column-parallel, wo row-parallel
     wq = eng.params["layers"]["wq"]
     assert wq.sharding.shard_shape(wq.shape)[2] == wq.shape[2] // 2
@@ -68,5 +69,6 @@ def test_tp_cache_is_sharded_over_kv_heads():
     assert wo.sharding.shard_shape(wo.shape)[1] == wo.shape[1] // 2
     # growth must preserve the committed sharding
     eng._grow_cache(32)
-    assert eng.k_cache.sharding.shard_shape(eng.k_cache.shape)[2] == 2
+    k0 = eng.k_cache[0]
+    assert k0.sharding.shard_shape(k0.shape)[1] == 2
     assert eng._cache_len == 32

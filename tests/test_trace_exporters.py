@@ -1,0 +1,101 @@
+"""Trace exporter wire formats: zipkin v2 JSON and OTLP/HTTP JSON.
+
+The reference selects jaeger/zipkin/gofr exporters by config
+(gofr.go:281-313). These tests pin the exact wire shapes a collector
+expects, capturing the POST body instead of needing a network.
+"""
+
+import json
+import sys
+import types
+
+from gofr_tpu.config import MockConfig
+from gofr_tpu.logging import MockLogger
+from gofr_tpu.tracing import (HTTPExporter, InMemoryExporter, LogExporter,
+                              NoopExporter, OTLPHTTPExporter, Tracer,
+                              ZipkinExporter, exporter_from_config)
+
+
+def _capture_posts(monkeypatch):
+    posts = []
+    mod = types.ModuleType("requests")
+
+    def post(url, data=None, headers=None, timeout=None):
+        posts.append((url, json.loads(data)))
+
+    mod.post = post
+    monkeypatch.setitem(sys.modules, "requests", mod)
+    return posts
+
+
+def _finished_span(exporter, name="GET /x", attrs=None, ok=True):
+    tracer = Tracer(exporter=exporter)
+    parent = tracer.start_span("parent")
+    span = tracer.start_span(name, parent=parent)
+    for key, value in (attrs or {}).items():
+        span.set_attribute(key, value)
+    if not ok:
+        span.set_status(False, "boom")
+    span.end()
+    return span
+
+
+def test_zipkin_v2_wire_format(monkeypatch):
+    posts = _capture_posts(monkeypatch)
+    exporter = ZipkinExporter("http://zipkin:9411/api/v2/spans",
+                              service_name="svc", batch_size=1)
+    span = _finished_span(exporter, attrs={"batch.id": 7}, ok=False)
+    assert len(posts) == 1
+    url, body = posts[0]
+    assert url.endswith("/api/v2/spans")
+    (z,) = body
+    assert z["traceId"] == span.trace_id
+    assert z["id"] == span.span_id
+    assert z["parentId"] == span.parent_id
+    assert z["localEndpoint"] == {"serviceName": "svc"}
+    assert z["tags"]["batch.id"] == "7"       # zipkin tags are strings
+    assert z["tags"]["error"] == "boom"
+    assert isinstance(z["timestamp"], int) and z["duration"] >= 1  # micros
+
+
+def test_otlp_http_wire_format(monkeypatch):
+    posts = _capture_posts(monkeypatch)
+    exporter = OTLPHTTPExporter("http://collector:4318/v1/traces",
+                                service_name="svc", batch_size=1)
+    span = _finished_span(exporter, attrs={"n": 3, "f": 0.5, "s": "x",
+                                           "b": True})
+    (url, body), = posts
+    rs = body["resourceSpans"][0]
+    assert {"key": "service.name", "value": {"stringValue": "svc"}} \
+        in rs["resource"]["attributes"]
+    (otlp,) = rs["scopeSpans"][0]["spans"]
+    assert otlp["traceId"] == span.trace_id
+    assert otlp["spanId"] == span.span_id
+    assert otlp["status"] == {"code": 1}
+    attrs = {a["key"]: a["value"] for a in otlp["attributes"]}
+    assert attrs["n"] == {"intValue": "3"}
+    assert attrs["f"] == {"doubleValue": 0.5}
+    assert attrs["s"] == {"stringValue": "x"}
+    assert attrs["b"] == {"boolValue": True}
+    assert otlp["startTimeUnixNano"].isdigit()
+
+
+def test_exporter_from_config_selects_wire_formats():
+    logger = MockLogger()
+    cases = {
+        "zipkin": ZipkinExporter,
+        "jaeger": OTLPHTTPExporter,
+        "otlp": OTLPHTTPExporter,
+        "gofr": HTTPExporter,
+        "memory": InMemoryExporter,
+        "log": LogExporter,
+        "": NoopExporter,
+    }
+    for name, cls in cases.items():
+        cfg = MockConfig({"TRACE_EXPORTER": name, "TRACER_URL": "http://c/t",
+                          "APP_NAME": "svc"})
+        exporter = exporter_from_config(cfg, logger)
+        assert type(exporter) is cls, name
+    # network exporter without a URL degrades to noop
+    cfg = MockConfig({"TRACE_EXPORTER": "zipkin"})
+    assert type(exporter_from_config(cfg, logger)) is NoopExporter
