@@ -1,0 +1,36 @@
+"""CLI application: subcommands, flags, datasource access from the shell.
+
+Mirrors the reference's examples/sample-cmd (gofr.NewCMD(), regex-matched
+subcommands, flags parsed into params, cmd/request.go:25-96).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from gofr_tpu.cmd import CMDApp  # noqa: E402
+
+
+def build_app(**kw) -> CMDApp:
+    app = CMDApp(**kw)
+
+    @app.sub_command("hello", description="greet someone")
+    def hello(ctx):
+        name = ctx.param("name") or "World"
+        return f"Hello {name}!"
+
+    @app.sub_command("count", description="increment the persistent counter")
+    def count(ctx):
+        return {"count": ctx.kv.incr("cli-runs")}
+
+    return app
+
+
+def main() -> int:
+    os.chdir(os.path.dirname(os.path.abspath(__file__)))
+    return build_app().run()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,46 @@
+"""Cron jobs + custom metrics.
+
+Mirrors the reference's examples/using-cron-jobs (5-field spec, per-run
+span, cron.go:281-295) and examples/using-custom-metrics (user-registered
+instruments via the metrics manager, metrics/register.go:15-25).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from gofr_tpu import App  # noqa: E402
+
+
+def build_app(**kw) -> App:
+    app = App(**kw)
+    metrics = app.container.metrics_manager
+    metrics.new_counter("app_cron_ticks_total", "cron job executions")
+    metrics.new_gauge("app_last_tick_unix", "wall time of the last tick")
+
+    def tick(ctx):
+        import time
+
+        ctx.metrics().increment_counter("app_cron_ticks_total")
+        ctx.metrics().set_gauge("app_last_tick_unix", time.time())
+        ctx.logger.infof("cron tick")
+
+    app.add_cron_job("* * * * *", "tick", tick)
+
+    @app.get("/ticks")
+    def ticks(ctx):
+        counter = ctx.metrics().get("app_cron_ticks_total")
+        series = getattr(counter, "series", {})
+        return {"ticks": sum(series.values()) if series else 0}
+
+    return app
+
+
+def main() -> None:
+    os.chdir(os.path.dirname(os.path.abspath(__file__)))
+    build_app().run()
+
+
+if __name__ == "__main__":
+    main()

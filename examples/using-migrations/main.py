@@ -1,0 +1,45 @@
+"""Versioned data migrations at boot.
+
+Mirrors the reference's examples/using-migrations: an ordered
+{version: up} map runs once, watermarked in gofr_migrations
+(migration/migration.go:18-79), before the server takes traffic.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from gofr_tpu import App  # noqa: E402
+
+
+def create_employees(ds):
+    ds.sql.exec("CREATE TABLE employee (id INTEGER PRIMARY KEY, name TEXT)")
+
+
+def seed_employees(ds):
+    ds.sql.exec("INSERT INTO employee (id, name) VALUES (?, ?)", 1, "grace")
+    ds.kv.set("seeded", "yes")
+
+
+def build_app(**kw) -> App:
+    app = App(**kw)
+    app.migrate({
+        20240101: create_employees,
+        20240102: seed_employees,
+    })
+
+    @app.get("/employee")
+    def employees(ctx):
+        return ctx.sql.select(dict, "SELECT * FROM employee")
+
+    return app
+
+
+def main() -> None:
+    os.chdir(os.path.dirname(os.path.abspath(__file__)))
+    build_app().run()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,39 @@
+"""HTTP ingress publishing to the pub/sub broker.
+
+Mirrors the reference's examples/using-publisher: a handler validates the
+body and publishes to a topic via the container's pub/sub client
+(gofr.go:360-368 wiring; the worker side is examples/pubsub-worker).
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from gofr_tpu import App  # noqa: E402
+from gofr_tpu.http.errors import InvalidParam  # noqa: E402
+
+
+def build_app(**kw) -> App:
+    app = App(**kw)
+
+    @app.post("/publish-order")
+    def publish_order(ctx):
+        body = ctx.bind()
+        if not isinstance(body, dict) or "id" not in body:
+            raise InvalidParam(["id"])
+        ctx.pubsub.publish("orders", json.dumps(body).encode(),
+                           key=str(body["id"]))
+        return {"published": body["id"]}
+
+    return app
+
+
+def main() -> None:
+    os.chdir(os.path.dirname(os.path.abspath(__file__)))
+    build_app().run()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,39 @@
+"""Declarative CRUD over a dataclass entity.
+
+Mirrors the reference's examples/using-add-rest-handlers: one
+add_rest_handlers call registers POST/GET/GET-by-id/PUT/DELETE for the
+entity, backed by the SQL datasource (crud_handlers.go:73-103).
+"""
+
+import dataclasses
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from gofr_tpu import App  # noqa: E402
+
+
+@dataclasses.dataclass
+class Book:
+    id: int = 0           # first field is the primary key
+    title: str = ""
+    author: str = ""
+
+
+def build_app(**kw) -> App:
+    app = App(**kw)
+    app.container.sql.exec(
+        "CREATE TABLE IF NOT EXISTS book "
+        "(id INTEGER PRIMARY KEY, title TEXT, author TEXT)")
+    app.add_rest_handlers(Book)
+    return app
+
+
+def main() -> None:
+    os.chdir(os.path.dirname(os.path.abspath(__file__)))
+    build_app().run()
+
+
+if __name__ == "__main__":
+    main()

@@ -42,7 +42,11 @@ DEFAULT_MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
 
 def decode_attention_reference(q, k_cache, v_cache, lengths):
     """Oracle in XLA. q: [B, H, dh]; k/v_cache: [B, Hkv, dh, S] (S-minor);
-    lengths: [B] live positions (query attends [0, lengths)). -> [B, H, dh]."""
+    lengths: [B] live positions (query attends [0, lengths)). -> [B, H, dh].
+
+    A row with lengths[b] == 0 returns ZEROS (there is nothing to attend);
+    a plain masked softmax would instead emit the uniform mean of junk v —
+    the kernel and this oracle agree on the zeros convention."""
     B, H, dh = q.shape
     Hkv, S = k_cache.shape[1], k_cache.shape[-1]
     G = H // Hkv
@@ -54,6 +58,7 @@ def decode_attention_reference(q, k_cache, v_cache, lengths):
                   DEFAULT_MASK_VALUE)
     p = jax.nn.softmax(s, axis=-1)
     out = jnp.einsum("bhgs,bhds->bhgd", p, v_cache.astype(jnp.float32))
+    out = jnp.where((lengths > 0)[:, None, None, None], out, 0.0)
     return out.reshape(B, H, dh).astype(q.dtype)
 
 

@@ -137,6 +137,24 @@ class PagedLLMEngine(LLMEngine):
         if self.mesh is not None:
             self._place_state()
 
+    def _place_state(self) -> None:
+        """Paged pools are STACKED [L, P, Hkv, dh, ps] arrays — the base
+        class's per-layer-tuple placement would iterate the leading axis
+        into L slices. Shard the pool's KV-head axis whole."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from ..parallel.sharding import kv_cache_spec
+
+        cache_s = NamedSharding(self.mesh, kv_cache_spec())
+        rep = NamedSharding(self.mesh, PartitionSpec())
+        self.k_cache = jax.device_put(self.k_cache, cache_s)
+        self.v_cache = jax.device_put(self.v_cache, cache_s)
+        self._tokens = jax.device_put(self._tokens, rep)
+        self._positions = jax.device_put(self._positions, rep)
+        self._temps = jax.device_put(self._temps, rep)
+        self.rng = jax.device_put(self.rng, rep)
+
     def pool_bytes(self) -> int:
         return 2 * self.k_cache.size * self.k_cache.dtype.itemsize
 
@@ -205,6 +223,11 @@ class PagedLLMEngine(LLMEngine):
                 warm_widths.add(_pow2_at_least(pages + 1))
             for width in sorted(warm_widths):
                 self._decode_program_paged(width)
+                if self.decode_block_size > 1:
+                    # the adaptive short-block variant fires under queue
+                    # pressure — exactly when a compile stall hurts most
+                    self._decode_program_paged(
+                        width, max(1, self.decode_block_size // 2))
 
     def _prefill_fn(self, bucket: int, K: int):
         cfg = self.cfg
@@ -282,9 +305,9 @@ class PagedLLMEngine(LLMEngine):
 
         return decode
 
-    def _decode_program_paged(self, n_table: int):
+    def _decode_program_paged(self, n_table: int, block: Optional[int] = None):
         jnp = self._jnp
-        block = self.decode_block_size
+        block = block or self.decode_block_size
         args = (self.params, self.k_cache, self.v_cache,
                 jnp.zeros((self.n_slots, n_table), dtype=jnp.int32),
                 self._tokens, self._positions, self._temps, self.rng)
@@ -344,7 +367,8 @@ class PagedLLMEngine(LLMEngine):
         table = np.zeros((self.n_slots, n_table), dtype=np.int32)
         for i, slot in active:
             table[i, :len(slot.pages)] = slot.pages
-        program = self._decode_program_paged(n_table)
+        block = self._decode_block_now()
+        program = self._decode_program_paged(n_table, block)
         snapshot = [(i, slot.request) for i, slot in active]
         start = _time.time()
         try:
@@ -356,10 +380,10 @@ class PagedLLMEngine(LLMEngine):
             raise CacheLostError(f"paged decode dispatch failed: {exc}") from exc
         dspan = self._dispatch_span("tpu.decode", next(self._batch_seq),
                                     **{"batch.size": len(snapshot),
-                                       "tpu.block": self.decode_block_size,
+                                       "tpu.block": block,
                                        "tpu.table_width": n_table})
         self._inflight.append(("decode", out_tokens, snapshot,
-                               self.decode_block_size, start, dspan))
+                               block, start, dspan))
 
     def _reset_device_state(self, exc: BaseException) -> None:
         # releasing slot pages happens via _finish_slot inside super(),

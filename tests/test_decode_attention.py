@@ -8,7 +8,8 @@ from gofr_tpu.ops.decode_attention import (decode_attention,
                                            decode_attention_reference)
 
 
-@pytest.mark.parametrize("lengths", [[5, 33, 64], [1, 1, 1], [64, 64, 64]])
+@pytest.mark.parametrize("lengths", [[5, 33, 64], [1, 1, 1], [64, 64, 64],
+                                     [0, 7, 64]])
 def test_kernel_matches_reference(lengths):
     rng = np.random.default_rng(0)
     B, H, Hkv, dh, S = 3, 8, 2, 16, 64

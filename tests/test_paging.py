@@ -228,6 +228,28 @@ def test_paged_explicit_pool_must_fit_budget():
                        budget_bytes=32 << 20)
 
 
+def test_paged_engine_with_tp_mesh():
+    """The paged pool is a STACKED array; mesh placement must shard its
+    KV-head axis whole, not iterate it into per-layer slices (the dense
+    engine's tuple placement)."""
+    from gofr_tpu.parallel import MeshPlan, make_mesh
+
+    mesh = make_mesh(MeshPlan(tp=2), devices=jax.devices()[:2])
+    params = llama_init(CFG, seed=0)
+    eng = PagedLLMEngine(params, CFG, n_slots=2, max_seq_len=64, page_size=8,
+                         prefill_buckets=(8,), mesh=mesh, logger=MockLogger())
+    eng.start()
+    try:
+        assert hasattr(eng.k_cache, "shape")  # still one stacked array
+        shard = eng.k_cache.sharding.shard_shape(eng.k_cache.shape)
+        assert shard[2] == CFG.n_kv_heads // 2
+        assert eng.pool_bytes() > 0
+        out = eng.generate([1, 2, 3], max_new_tokens=4, temperature=0.0)
+        assert len(out) == 4
+    finally:
+        eng.stop()
+
+
 def test_paged_engine_streaming_and_stop_tokens():
     eng = _make_paged()
     try:
