@@ -71,7 +71,12 @@ class Container:
             from ..datasource.sql import SQL
             c.sql = SQL(config, c.logger, c.metrics_manager)
 
-        if config.get_bool("KV_ENABLED", False) or config.get_or_default("KV_STORE", ""):
+        kv_backend = config.get_or_default("KV_STORE", "").lower()
+        if kv_backend == "redis":
+            # network twin, gated on redis-py (reference redis.go:35-64)
+            from ..datasource.kvredis import RedisKVStore
+            c.kv = RedisKVStore(config, c.logger, c.metrics_manager)
+        elif config.get_bool("KV_ENABLED", False) or kv_backend:
             from ..datasource.kvstore import KVStore
             c.kv = KVStore(config, c.logger, c.metrics_manager)
 
@@ -194,7 +199,7 @@ class Container:
         return out
 
     def close(self) -> None:
-        for source in (self.sql, self.pubsub, self.tpu, self.docstore):
+        for source in (self.sql, self.kv, self.pubsub, self.tpu, self.docstore):
             if source is not None and hasattr(source, "close"):
                 try:
                     source.close()

@@ -4,9 +4,10 @@ Parity: reference pkg/gofr/datasource/redis/ — go-redis command surface the
 framework actually uses (get/set/del/incr/expire/hset/hget, TxPipeline for
 migrations redis.go:70-135), per-command logging+metrics hook (hook.go:67-105),
 health via INFO-style stats (health.go:13-42). The reference dials a Redis
-server; in this zero-egress environment the bundled backend is an in-process
-store with the same semantics (the "miniredis" tier the reference itself uses
-in tests), so user code and migrations run unchanged.
+server; the bundled backend here is an in-process store with the same
+semantics (the "miniredis" tier the reference itself uses in tests), so user
+code and migrations run unchanged. KV_STORE=redis swaps in the gated
+redis-py network client (datasource/kvredis.py) with the identical surface.
 """
 
 from __future__ import annotations

@@ -8,6 +8,7 @@ the REAL adapter code: connect kwargs, bindvar translation, cursor
 protocol, ping-retry redial, poll/commit flow.
 """
 
+import json as json_mod
 import sys
 import threading
 import time
@@ -387,3 +388,303 @@ def test_kafka_adapter_health(monkeypatch):
     health = adapter.health_check()
     assert health.status == "UP"
     assert health.details["backend"] == "kafka"
+
+
+# -- fake redis-py ------------------------------------------------------------
+class FakeRedis:
+    instances: List["FakeRedis"] = []
+
+    def __init__(self, host=None, port=None, db=0, decode_responses=False):
+        self.kwargs = dict(host=host, port=port, db=db)
+        self.store: Dict[str, Any] = {}
+        self.hashes: Dict[str, Dict[str, Any]] = {}
+        self.commands = 0
+        FakeRedis.instances.append(self)
+
+    def ping(self):
+        return True
+
+    def set(self, key, value, ex=None, px=None):
+        self.commands += 1
+        self.last_px = px
+        self.store[key] = str(value)
+
+    def get(self, key):
+        return self.store.get(key)
+
+    def delete(self, *keys):
+        return sum(1 for k in keys if self.store.pop(k, None) is not None)
+
+    def exists(self, key):
+        return 1 if key in self.store else 0
+
+    def incrby(self, key, by):
+        val = int(self.store.get(key, 0)) + by
+        self.store[key] = str(val)
+        return val
+
+    def expire(self, key, ttl):
+        return key in self.store
+
+    def ttl(self, key):
+        return 42 if key in self.store else -2
+
+    def keys(self, pattern):
+        return list(self.store)
+
+    def hset(self, key, field, value):
+        self.hashes.setdefault(key, {})[field] = str(value)
+
+    def hget(self, key, field):
+        return self.hashes.get(key, {}).get(field)
+
+    def hgetall(self, key):
+        return dict(self.hashes.get(key, {}))
+
+    def flushall(self):
+        self.store.clear()
+        self.hashes.clear()
+
+    def info(self, section):
+        return {"total_commands_processed": self.commands}
+
+    def pipeline(self, transaction=False):
+        outer = self
+
+        class _Pipe:
+            def __init__(self):
+                self.ops = []
+
+            def set(self, key, value, px=None):
+                self.ops.append(("set", key, value))
+
+            def hset(self, key, field, value):
+                self.ops.append(("hset", key, field, value))
+
+            def delete(self, key):
+                self.ops.append(("del", key))
+
+            def execute(self):
+                for op in self.ops:
+                    getattr(outer, {"set": "set", "hset": "hset",
+                                    "del": "delete"}[op[0]])(*op[1:])
+                self.ops = []
+
+            def reset(self):
+                self.ops = []
+
+        return _Pipe()
+
+    def close(self):
+        pass
+
+
+def test_redis_kvstore_adapter(monkeypatch):
+    mod = types.ModuleType("redis")
+    mod.Redis = FakeRedis
+    monkeypatch.setitem(sys.modules, "redis", mod)
+    FakeRedis.instances.clear()
+
+    from gofr_tpu.datasource.kvredis import RedisKVStore
+
+    cfg = MockConfig({"REDIS_HOST": "cache.internal", "REDIS_PORT": "6380"})
+    kv = RedisKVStore(cfg, MockLogger(), None)
+    assert FakeRedis.instances[0].kwargs == {"host": "cache.internal",
+                                             "port": 6380, "db": 0}
+    kv.set("a", "1")
+    assert kv.get("a") == "1"
+    assert kv.incr("n") == 1 and kv.incr("n", 4) == 5 and kv.decr("n") == 4
+    kv.hset("h", "f", "v")
+    assert kv.hget("h", "f") == "v" and kv.hgetall("h") == {"f": "v"}
+    assert kv.exists("a") and kv.delete("a") == 1 and not kv.exists("a")
+    # sub-second TTLs ride as milliseconds, never the invalid EX 0
+    kv.set("t", "v", ttl_s=0.5)
+    assert FakeRedis.instances[0].last_px == 500
+    # structured hash values (the migration watermark) JSON-encode
+    kv.hset("gofr_migrations", "1", {"method": "UP", "duration": 3})
+    assert json_mod.loads(kv.hget("gofr_migrations", "1"))["method"] == "UP"
+    # atomic pipeline mirrors kvstore.Pipeline
+    pipe = kv.pipeline()
+    pipe.set("p1", "x").hset("ph", "f", "y")
+    pipe.exec()
+    assert kv.get("p1") == "x" and kv.hget("ph", "f") == "y"
+    health = kv.health_check()
+    assert health.status == "UP" and health.details["backend"] == "redis"
+    kv.close()
+
+
+def test_redis_kvstore_container_wiring(monkeypatch):
+    mod = types.ModuleType("redis")
+    mod.Redis = FakeRedis
+    monkeypatch.setitem(sys.modules, "redis", mod)
+
+    from gofr_tpu.container import Container
+    from gofr_tpu.datasource.kvredis import RedisKVStore
+
+    c = Container.create(MockConfig({"KV_STORE": "redis"}))
+    assert isinstance(c.kv, RedisKVStore)
+    c.kv.set("x", "y")
+    assert c.kv.get("x") == "y"
+
+
+def test_redis_missing_driver_stays_down(monkeypatch):
+    monkeypatch.setitem(sys.modules, "redis", None)
+
+    from gofr_tpu.datasource.kvredis import RedisKVStore
+
+    kv = RedisKVStore(MockConfig({}), MockLogger(), None)
+    assert kv.health_check().status == "DOWN"
+    with pytest.raises(ConnectionError):
+        kv.get("a")
+
+
+# -- fake paho-mqtt -----------------------------------------------------------
+class FakeMQTTClient:
+    def __init__(self):
+        self.on_message = None
+        self.subscriptions = []
+        self.connected = False
+
+    def connect(self, host, port):
+        self.connect_args = (host, port)
+        self.connected = True
+
+    def loop_start(self):
+        pass
+
+    def publish(self, topic, payload, qos=0):
+        msg = types.SimpleNamespace(topic=topic, payload=payload, qos=qos)
+        if self.on_message:               # local echo models the broker
+            self.on_message(self, None, msg)
+
+    def subscribe(self, topic, qos=0):
+        self.subscriptions.append((topic, qos))
+
+    def unsubscribe(self, topic):
+        pass
+
+    def is_connected(self):
+        return self.connected
+
+    def loop_stop(self):
+        pass
+
+    def disconnect(self):
+        self.connected = False
+
+
+def test_mqtt_adapter_pubsub_and_wildcards(monkeypatch):
+    mqtt_mod = types.ModuleType("paho.mqtt.client")
+    mqtt_mod.Client = FakeMQTTClient
+    paho = types.ModuleType("paho")
+    paho_mqtt = types.ModuleType("paho.mqtt")
+    monkeypatch.setitem(sys.modules, "paho", paho)
+    monkeypatch.setitem(sys.modules, "paho.mqtt", paho_mqtt)
+    monkeypatch.setitem(sys.modules, "paho.mqtt.client", mqtt_mod)
+
+    from gofr_tpu.pubsub.external import MQTTAdapter
+
+    cfg = MockConfig({"MQTT_HOST": "broker", "MQTT_PORT": "1884",
+                      "MQTT_QOS": "1"})
+    adapter = MQTTAdapter(cfg, MockLogger(), None)
+    assert adapter._client.connect_args == ("broker", 1884)
+
+    # drain a pending subscription queue before publish (push->pull bridge)
+    assert adapter.subscribe("sensors/+", timeout_s=0.05) is None
+    adapter.publish("sensors/one", b"21.5")
+    msg = adapter.subscribe("sensors/+", timeout_s=1)
+    assert msg is not None and msg.value == b"21.5"
+    assert msg.metadata["qos"] == 1
+    # exact-topic subscription
+    adapter.publish("alerts", b"fire")
+    assert adapter.subscribe("alerts", timeout_s=1).value == b"fire"
+    assert adapter.health_check().status == "UP"
+    adapter.close()
+    assert adapter.health_check().status == "DOWN"
+
+
+# -- fake google-cloud-pubsub -------------------------------------------------
+class _DeadlineExceeded(Exception):
+    pass
+
+
+_DeadlineExceeded.__name__ = "DeadlineExceeded"
+
+
+class FakeGPublisher:
+    def __init__(self, topics):
+        self.topics = topics
+
+    def topic_path(self, project, topic):
+        return f"projects/{project}/topics/{topic}"
+
+    def create_topic(self, name=None):
+        self.topics.setdefault(name, [])
+
+    def publish(self, topic_path, message, **attrs):
+        self.topics.setdefault(topic_path, []).append(
+            types.SimpleNamespace(data=message, attributes=attrs))
+
+        class _F:
+            def result(self):
+                return "id"
+        return _F()
+
+
+class FakeGSubscriber:
+    def __init__(self, topics, acks):
+        self.topics = topics
+        self.acks = acks
+        self.subs = {}
+        self.empty_pulls_before_delivery = 0
+
+    def subscription_path(self, project, name):
+        return f"projects/{project}/subscriptions/{name}"
+
+    def create_subscription(self, name=None, topic=None):
+        self.subs[name] = {"topic": topic, "pos": 0}
+
+    def pull(self, subscription=None, max_messages=1, timeout=None):
+        if self.empty_pulls_before_delivery > 0:
+            self.empty_pulls_before_delivery -= 1
+            raise _DeadlineExceeded("Deadline Exceeded")
+        sub = self.subs[subscription]
+        log = self.topics.get(sub["topic"], [])
+        if sub["pos"] >= len(log):
+            raise _DeadlineExceeded("Deadline Exceeded")
+        message = log[sub["pos"]]
+        sub["pos"] += 1
+        received = types.SimpleNamespace(
+            ack_id=f"ack-{sub['pos']}", message=message)
+        return types.SimpleNamespace(received_messages=[received])
+
+    def acknowledge(self, subscription=None, ack_ids=None):
+        self.acks.extend(ack_ids)
+
+
+def test_google_pubsub_adapter(monkeypatch):
+    topics: Dict[str, list] = {}
+    acks: List[str] = []
+    mod = types.ModuleType("google.cloud.pubsub_v1")
+    mod.PublisherClient = lambda: FakeGPublisher(topics)
+    mod.SubscriberClient = lambda: FakeGSubscriber(topics, acks)
+    google_mod = types.ModuleType("google")
+    cloud_mod = types.ModuleType("google.cloud")
+    monkeypatch.setitem(sys.modules, "google", google_mod)
+    monkeypatch.setitem(sys.modules, "google.cloud", cloud_mod)
+    monkeypatch.setitem(sys.modules, "google.cloud.pubsub_v1", mod)
+
+    from gofr_tpu.pubsub.external import GooglePubSubAdapter
+
+    adapter = GooglePubSubAdapter(MockConfig({"GOOGLE_PROJECT_ID": "proj"}),
+                                  MockLogger(), None)
+    adapter.publish("jobs", b"work-1")
+    # an empty pull surfaces as DeadlineExceeded: treated as no-message-yet,
+    # the poll keeps waiting until the deadline instead of erroring
+    adapter._subscriber.empty_pulls_before_delivery = 2
+    msg = adapter.subscribe("jobs", timeout_s=5)
+    assert msg is not None and msg.value == b"work-1"
+    msg.commit()
+    assert acks == ["ack-1"]
+    # drained topic: DeadlineExceeded until the timeout, then None
+    assert adapter.subscribe("jobs", timeout_s=0.2) is None
