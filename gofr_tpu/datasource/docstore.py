@@ -11,6 +11,9 @@ clients) follows.
 The bundled backend is an in-process document store with Mongo-style filter
 operators ($gt/$gte/$lt/$lte/$ne/$in) and optional JSON-file persistence —
 the zero-egress tier; the API surface is what user code programs against.
+The network twin is datasource/mongostore.MongoDocumentStore (same provider
+pattern + operation surface; its constructor raises cleanly when pymongo is
+absent), injected via App.add_document_store.
 """
 
 from __future__ import annotations

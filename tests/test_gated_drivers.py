@@ -688,3 +688,131 @@ def test_google_pubsub_adapter(monkeypatch):
     assert acks == ["ack-1"]
     # drained topic: DeadlineExceeded until the timeout, then None
     assert adapter.subscribe("jobs", timeout_s=0.2) is None
+
+
+# -- fake pymongo -------------------------------------------------------------
+class FakeMongoCollection:
+    def __init__(self):
+        self.docs: List[Dict[str, Any]] = []
+        self._ids = 0
+
+    @staticmethod
+    def _matches(doc, flt):
+        return all(doc.get(k) == v for k, v in (flt or {}).items())
+
+    def insert_one(self, doc):
+        self._ids += 1
+        doc.setdefault("_id", self._ids)
+        self.docs.append(doc)
+        return types.SimpleNamespace(inserted_id=doc["_id"])
+
+    def insert_many(self, docs):
+        return types.SimpleNamespace(
+            inserted_ids=[self.insert_one(d).inserted_id for d in docs])
+
+    def find(self, flt):
+        matched = [d for d in self.docs if self._matches(d, flt)]
+
+        class _Cursor(list):
+            def limit(self, n):
+                return _Cursor(self[:n])
+        return _Cursor(matched)
+
+    def find_one(self, flt):
+        for d in self.docs:
+            if self._matches(d, flt):
+                return d
+        return None
+
+    def update_one(self, flt, update):
+        for d in self.docs:
+            if self._matches(d, flt):
+                d.update(update.get("$set", {}))
+                return types.SimpleNamespace(modified_count=1)
+        return types.SimpleNamespace(modified_count=0)
+
+    def update_many(self, flt, update):
+        n = 0
+        for d in self.docs:
+            if self._matches(d, flt):
+                d.update(update.get("$set", {}))
+                n += 1
+        return types.SimpleNamespace(modified_count=n)
+
+    def delete_one(self, flt):
+        for i, d in enumerate(self.docs):
+            if self._matches(d, flt):
+                del self.docs[i]
+                return types.SimpleNamespace(deleted_count=1)
+        return types.SimpleNamespace(deleted_count=0)
+
+    def delete_many(self, flt):
+        before = len(self.docs)
+        self.docs = [d for d in self.docs if not self._matches(d, flt)]
+        return types.SimpleNamespace(deleted_count=before - len(self.docs))
+
+    def count_documents(self, flt):
+        return len([d for d in self.docs if self._matches(d, flt)])
+
+    def drop(self):
+        self.docs = []
+
+
+class FakeMongoDB(dict):
+    def __missing__(self, name):
+        self[name] = FakeMongoCollection()
+        return self[name]
+
+    def create_collection(self, name):
+        _ = self[name]
+
+
+class FakeMongoClient:
+    def __init__(self, uri, serverSelectionTimeoutMS=None):
+        self.uri = uri
+        self.dbs: Dict[str, FakeMongoDB] = {}
+        self.admin = types.SimpleNamespace(command=lambda cmd: {"ok": 1})
+
+    def __getitem__(self, name):
+        return self.dbs.setdefault(name, FakeMongoDB())
+
+    def close(self):
+        pass
+
+
+def test_mongo_docstore_adapter(monkeypatch):
+    mod = types.ModuleType("pymongo")
+    mod.MongoClient = FakeMongoClient
+    monkeypatch.setitem(sys.modules, "pymongo", mod)
+
+    from gofr_tpu.datasource.mongostore import MongoDocumentStore
+
+    cfg = MockConfig({"MONGO_URI": "mongodb://db:27017",
+                      "MONGO_DATABASE": "appdb"})
+    store = MongoDocumentStore(cfg)
+    store.use_logger(MockLogger())
+    store.connect()
+
+    store.insert_one("users", {"name": "ada", "age": 36})
+    store.insert_many("users", [{"name": "bob"}, {"name": "eve"}])
+    assert store.count_documents("users") == 3
+    assert store.find_one("users", {"name": "ada"})["age"] == 36
+    # plain-field update becomes $set (bundled-store semantics)
+    assert store.update_one("users", {"name": "ada"}, {"age": 37}) == 1
+    assert store.find_one("users", {"name": "ada"})["age"] == 37
+    # operator updates pass through
+    assert store.update_many("users", {}, {"$set": {"active": True}}) == 3
+    assert store.delete_one("users", {"name": "bob"}) == 1
+    assert len(store.find("users", {})) == 2
+    assert store.health_check().status == "UP"
+    store.close()
+    assert store.health_check().status == "DOWN"
+
+
+def test_mongo_missing_driver_raises_cleanly(monkeypatch):
+    monkeypatch.setitem(sys.modules, "pymongo", None)
+
+    from gofr_tpu.datasource.mongostore import MongoDocumentStore
+
+    with pytest.raises(RuntimeError, match="pymongo"):
+        MongoDocumentStore(MockConfig({"MONGO_URI": "m", "MONGO_DATABASE": "d"}))
