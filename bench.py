@@ -77,21 +77,30 @@ def _probe_once(timeout_s: float):
 
 def _probe_accelerator():
     """Probe with retry + backoff: a wedged PJRT tunnel recovers after the
-    stale client's lease lapses (minutes), so one attempt under-reports.
+    stale client's lease lapses (observed: many minutes), so one attempt
+    under-reports. BUDGET-AWARE: keep probing as long as enough budget
+    remains for a full TPU run (~7 min) — a recovering tunnel 10 minutes in
+    is still worth far more than an early CPU fallback.
     Returns (on_tpu, reason)."""
     reason = "unknown"
-    for attempt, (timeout_s, sleep_s) in enumerate(
-            [(180.0, 30.0), (120.0, 60.0), (150.0, 0.0)]):
-        if _left() < timeout_s + 120:  # keep room for the CPU fallback run
+    FULL_RUN_S = 420.0  # warmup + T0 + T1 + L on the chip
+    attempt = 0
+    while True:
+        timeout_s = 180.0 if attempt == 0 else 120.0
+        if _left() < timeout_s + FULL_RUN_S:
             return False, f"probe budget exhausted after attempt {attempt} ({reason})"
         ok, reason = _probe_once(timeout_s)
         if ok:
             return True, reason
-        print(f"[bench] probe attempt {attempt + 1} failed: {reason}; "
+        attempt += 1
+        sleep_s = min(60.0, 15.0 * attempt)
+        if _left() - sleep_s < 120.0 + FULL_RUN_S:
+            # the post-sleep check would fail anyway: save the budget for
+            # the CPU fallback instead of sleeping into exhaustion
+            return False, f"probe budget exhausted after attempt {attempt} ({reason})"
+        print(f"[bench] probe attempt {attempt} failed: {reason}; "
               f"retrying in {sleep_s:.0f}s", file=sys.stderr)
-        if sleep_s:
-            time.sleep(sleep_s)
-    return False, reason
+        time.sleep(sleep_s)
 
 
 def _prompt_mix(rng, n, vocab, limit):

@@ -164,12 +164,15 @@ class DocumentStore:
         return ids
 
     def find(self, collection: str,
-             filter: Optional[Dict[str, Any]] = None) -> List[Dict[str, Any]]:
+             filter: Optional[Dict[str, Any]] = None,
+             limit: int = 0) -> List[Dict[str, Any]]:
         self._require_connected()
         start = time.time()
         with self._lock:
             out = [copy.deepcopy(d) for d in self._coll(collection)
                    if _matches(d, filter or {})]
+        if limit:
+            out = out[:limit]
         self._observe("find", collection, start)
         return out
 

@@ -123,7 +123,10 @@ class MongoDocumentStore:
         result = self._require()[collection].update_one(
             filter, self._as_update(update))
         self._observe("updateOne", collection, start)
-        return result.modified_count
+        # matched, not modified: the bundled store counts every matched
+        # target even when the write is a no-op — parity over Mongo's
+        # modified_count quirk
+        return result.matched_count
 
     def update_many(self, collection: str, filter: Dict[str, Any],
                     update: Dict[str, Any]) -> int:
@@ -131,7 +134,7 @@ class MongoDocumentStore:
         result = self._require()[collection].update_many(
             filter, self._as_update(update))
         self._observe("updateMany", collection, start)
-        return result.modified_count
+        return result.matched_count
 
     @staticmethod
     def _as_update(update: Dict[str, Any]) -> Dict[str, Any]:
@@ -162,9 +165,10 @@ class MongoDocumentStore:
 
     def create_collection(self, collection: str) -> None:
         start = time.time()
+        db = self._require()  # not-connected must raise, not be swallowed
         try:
-            self._require().create_collection(collection)
-        except Exception:  # noqa: BLE001 - already exists
+            db.create_collection(collection)
+        except self._pymongo.errors.CollectionInvalid:  # already exists
             pass
         self._observe("createCollection", collection, start)
 
@@ -173,11 +177,20 @@ class MongoDocumentStore:
         self._require()[collection].drop()
         self._observe("dropCollection", collection, start)
 
+    @staticmethod
+    def _redact(uri: str) -> str:
+        """Strip userinfo from the URI — health details flow into the
+        public /.well-known/health aggregate."""
+        import re
+
+        return re.sub(r"//[^@/]+@", "//", uri)
+
     # -- health ---------------------------------------------------------------
     def health_check(self) -> Health:
         if self._client is None:
             return Health(status=STATUS_DOWN,
-                          details={"backend": "mongo", "uri": self.uri})
+                          details={"backend": "mongo",
+                                   "uri": self._redact(self.uri)})
         try:
             self._client.admin.command("ping")
             return Health(status=STATUS_UP, details={

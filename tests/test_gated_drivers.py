@@ -728,8 +728,8 @@ class FakeMongoCollection:
         for d in self.docs:
             if self._matches(d, flt):
                 d.update(update.get("$set", {}))
-                return types.SimpleNamespace(modified_count=1)
-        return types.SimpleNamespace(modified_count=0)
+                return types.SimpleNamespace(matched_count=1, modified_count=1)
+        return types.SimpleNamespace(matched_count=0, modified_count=0)
 
     def update_many(self, flt, update):
         n = 0
@@ -737,7 +737,7 @@ class FakeMongoCollection:
             if self._matches(d, flt):
                 d.update(update.get("$set", {}))
                 n += 1
-        return types.SimpleNamespace(modified_count=n)
+        return types.SimpleNamespace(matched_count=n, modified_count=n)
 
     def delete_one(self, flt):
         for i, d in enumerate(self.docs):
@@ -783,11 +783,13 @@ class FakeMongoClient:
 def test_mongo_docstore_adapter(monkeypatch):
     mod = types.ModuleType("pymongo")
     mod.MongoClient = FakeMongoClient
+    mod.errors = types.SimpleNamespace(
+        CollectionInvalid=type("CollectionInvalid", (Exception,), {}))
     monkeypatch.setitem(sys.modules, "pymongo", mod)
 
     from gofr_tpu.datasource.mongostore import MongoDocumentStore
 
-    cfg = MockConfig({"MONGO_URI": "mongodb://db:27017",
+    cfg = MockConfig({"MONGO_URI": "mongodb://app:s3cret@db:27017",
                       "MONGO_DATABASE": "appdb"})
     store = MongoDocumentStore(cfg)
     store.use_logger(MockLogger())
@@ -804,9 +806,16 @@ def test_mongo_docstore_adapter(monkeypatch):
     assert store.update_many("users", {}, {"$set": {"active": True}}) == 3
     assert store.delete_one("users", {"name": "bob"}) == 1
     assert len(store.find("users", {})) == 2
+    # matched-count parity with the bundled store: a no-op write still
+    # counts the matched document
+    assert store.update_one("users", {"name": "ada"}, {"age": 37}) == 1
     assert store.health_check().status == "UP"
     store.close()
-    assert store.health_check().status == "DOWN"
+    health = store.health_check()
+    assert health.status == "DOWN"
+    # credentials never leak into the health aggregate
+    assert "s3cret" not in str(health.details)
+    assert health.details["uri"] == "mongodb://db:27017"
 
 
 def test_mongo_missing_driver_raises_cleanly(monkeypatch):
