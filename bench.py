@@ -142,7 +142,8 @@ def run_phase_throughput(engine, prompts, max_new, rounds=1):
 
 
 def run_phase_latency(engine, prompts, max_new, rate_rps, duration_s, rng):
-    """Poisson arrivals at rate_rps for duration_s; returns TTFT list.
+    """Poisson arrivals at rate_rps for duration_s; returns the completed
+    requests (their timestamps decompose TTFT into queue wait vs prefill).
 
     Draining sequentially is fine: TTFT is stamped by the engine loop at
     sync time, not by the consumer, and per-request queues are unbounded."""
@@ -154,8 +155,7 @@ def run_phase_latency(engine, prompts, max_new, rate_rps, duration_s, rng):
         time.sleep(float(rng.exponential(1.0 / rate_rps)))
     for r in reqs:
         r.result(timeout_s=900)
-    return [r.first_token_at - r.enqueued_at for r in reqs
-            if r.first_token_at is not None]
+    return reqs
 
 
 class _Record:
@@ -407,13 +407,24 @@ def main() -> None:
     try:
         if engine is not None and full_run and mixed_tok_s and _left() > 120:
             rate = 0.7 * mixed_tok_s / max_new
-            ttfts = run_phase_latency(engine, prompts, max_new, rate,
-                                      duration_s=min(25.0, _left() - 60), rng=rng)
+            reqs = run_phase_latency(engine, prompts, max_new, rate,
+                                     duration_s=min(25.0, _left() - 60),
+                                     rng=rng)
+            ttfts = [r.first_token_at - r.enqueued_at for r in reqs
+                     if r.first_token_at is not None]
+            waits = [r.admitted_at - r.enqueued_at for r in reqs
+                     if r.admitted_at is not None]
             p50, p99 = _percentiles(ttfts)
+            wait_p50, _ = _percentiles(waits)
             print(f"[bench] L ttft@poisson({rate:.1f} rps): p50={p50*1e3:.0f}ms "
-                  f"p99={p99*1e3:.0f}ms n={len(ttfts)}", file=sys.stderr)
+                  f"p99={p99*1e3:.0f}ms (queue-wait p50={wait_p50*1e3:.0f}ms) "
+                  f"n={len(ttfts)}", file=sys.stderr)
             record.update(ttft_p50_ms=round(p50 * 1e3, 1),
                           ttft_p99_ms=round(p99 * 1e3, 1),
+                          # decomposition: time waiting for a slot/admission
+                          # vs time from prefill dispatch to first token —
+                          # tells the next round WHICH latency to attack
+                          ttft_queue_wait_p50_ms=round(wait_p50 * 1e3, 1),
                           ttft_arrival_rps=round(rate, 2))
         elif burst_ttfts:
             p50, p99 = _percentiles(burst_ttfts)

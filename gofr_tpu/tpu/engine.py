@@ -80,6 +80,7 @@ class GenerationRequest:
         self.cancelled = threading.Event()
         self.error: Optional[BaseException] = None
         self.enqueued_at = time.time()
+        self.admitted_at: Optional[float] = None   # prefill dispatch time
         self.first_token_at: Optional[float] = None
         self.finished_at: Optional[float] = None
         self.generated = 0
@@ -726,7 +727,9 @@ class LLMEngine:
         fused dispatch this request rode in), tpu.slot, tpu.prefill_bucket.
         """
         admitted = []
+        now = time.time()
         for row, request in enumerate(batch):
+            request.admitted_at = now  # queue wait ends; prefill in flight
             slot = self.slots[slots_idx[row]]
             slot.request = request
             # length counts tokens whose KV is in the cache (the prompt); the

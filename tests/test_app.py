@@ -91,10 +91,8 @@ def test_handler_backpressure_503():
     """MAX_CONCURRENT_REQUESTS bounds RUNNING handlers (including
     408-abandoned ones): excess requests get a fast 503 instead of
     unbounded thread growth (VERDICT r2 weak #7)."""
-    import threading as _threading
-
     app = make_app({"REQUEST_TIMEOUT": "0.3", "MAX_CONCURRENT_REQUESTS": "2"})
-    release = _threading.Event()
+    release = threading.Event()
 
     @app.get("/stall")
     def stall(ctx):
@@ -128,6 +126,47 @@ def test_handler_backpressure_503():
         assert requests.get(f"{base}/fast").status_code == 200
     finally:
         release.set()
+        app.shutdown()
+
+
+def test_streaming_holds_its_concurrency_slot():
+    """A streaming body generates AFTER the handler thread returns; the
+    concurrency slot must follow the stream's lifetime, or N streaming
+    clients (the LLM workload) would hold zero slots."""
+    app = make_app({"MAX_CONCURRENT_REQUESTS": "1"})
+    gate = threading.Event()
+
+    @app.get("/tokens")
+    def tokens(ctx):
+        def chunks():
+            yield "first"
+            gate.wait(timeout=20)
+            yield "last"
+        return Stream(chunks(), sse=True)
+
+    @app.get("/fast")
+    def fast(ctx):
+        return "ok"
+
+    app.start()
+    try:
+        base = f"http://127.0.0.1:{app.http_port}"
+        with requests.get(f"{base}/tokens", stream=True) as r:
+            lines = r.iter_lines()
+            assert next(line for line in lines if line) == b"data: first"
+            # the stream is mid-body: its slot is held, others shed
+            assert requests.get(f"{base}/fast").status_code == 503
+            gate.set()
+            assert next(line for line in lines if line) == b"data: last"
+        # stream finished -> slot released
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if requests.get(f"{base}/fast").status_code == 200:
+                break
+            time.sleep(0.05)
+        assert requests.get(f"{base}/fast").status_code == 200
+    finally:
+        gate.set()
         app.shutdown()
 
 
