@@ -103,6 +103,7 @@ class TPUClient:
                 pass
         for name, desc, buckets in (
             ("app_tpu_ttft_seconds", "time to first token", TTFT_BUCKETS),
+            ("app_tpu_queue_wait_seconds", "submit-to-admission wait", TTFT_BUCKETS),
             ("app_tpu_tpot_seconds", "time per output token", TPOT_BUCKETS),
             ("app_tpu_batch_size", "assembled batch sizes", BATCH_BUCKETS),
             ("app_tpu_execute_seconds", "device execution wall time", TPOT_BUCKETS),

@@ -730,6 +730,8 @@ class LLMEngine:
         now = time.time()
         for row, request in enumerate(batch):
             request.admitted_at = now  # queue wait ends; prefill in flight
+            self._obs.hist("app_tpu_queue_wait_seconds",
+                           now - request.enqueued_at)
             slot = self.slots[slots_idx[row]]
             slot.request = request
             # length counts tokens whose KV is in the cache (the prompt); the
