@@ -70,6 +70,10 @@ def build_engine(app: App) -> LLMEngine:
         logger=app.logger,
         mesh=mesh,
         tracer=app.container.tracer,
+        # >0 splits long prompts into bounded chunk dispatches so decode
+        # blocks interleave (TTFT under mixed traffic); must divide the
+        # buckets it applies to
+        chunk_prefill_tokens=app.config.get_int("CHUNK_PREFILL_TOKENS", 0),
     )
     engine.tokenizer = tokenizer
     engine.start()

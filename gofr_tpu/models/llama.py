@@ -403,6 +403,49 @@ def llama_decode_step_inplace(params, cfg: LlamaConfig, tokens, positions,
     return logits, k_cache, v_cache
 
 
+def llama_prefill_chunk(params, cfg: LlamaConfig, tokens, positions,
+                        k_layers, v_layers, slots, project_last=None):
+    """One CHUNK of a cached prefill over the per-layer serving caches.
+
+    tokens: [K, C] the chunk's token ids; positions: [K, C] their absolute
+    positions (a later chunk attends the earlier chunks' KV already written
+    in the cache rows — the mask `j <= q_pos` needs nothing more);
+    k/v_layers: per-layer cache tuples ([B, Hkv, dh, S]); slots: [K] row
+    ids. Gathers the K rows, runs the cache-aware attention for the chunk,
+    scatters the rows back.
+
+    project_last: None for intermediate chunks (no lm_head work at all);
+    an int32 [K] of within-chunk last indices for the FINAL chunk —
+    gathers those hidden rows and projects [K, V] logits.
+
+    This is the building block for chunked prefill: a long prompt is
+    admitted as several bounded dispatches so decode blocks (and other
+    admissions) interleave instead of stalling behind one huge prefill —
+    the TTFT lever for mixed traffic.
+    Returns (logits [K, V] or None, k_layers, v_layers).
+    """
+    k_out = list(k_layers)
+    v_out = list(v_layers)
+    x = params["tok_emb"][tokens]                          # [K, C, D]
+    for l in range(cfg.n_layers):
+        layer = jax.tree_util.tree_map(lambda w: w[l], params["layers"])
+        k_rows = k_out[l][slots]                           # [K, Hkv, dh, S]
+        v_rows = v_out[l][slots]
+        attn, k_rows, v_rows = _attention_block(x, layer, k_rows, v_rows,
+                                                positions, cfg)
+        x = x + attn
+        x = x + _ffn_block(x, layer, cfg)
+        k_out[l] = k_out[l].at[slots].set(k_rows)
+        v_out[l] = v_out[l].at[slots].set(v_rows)
+    if project_last is None:
+        return None, tuple(k_out), tuple(v_out)
+    x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+    K = x.shape[0]
+    last = x[jnp.arange(K), project_last]                  # [K, D]
+    logits = (last @ params["lm_head"]).astype(jnp.float32)
+    return logits, tuple(k_out), tuple(v_out)
+
+
 def llama_decode_step_paged(params, cfg: LlamaConfig, tokens, positions,
                             k_pool, v_pool, table):
     """One decode step against a PAGED KV cache.

@@ -45,7 +45,8 @@ import time
 from typing import Any, Dict, Iterator, List, Optional, Sequence, Set, Tuple
 
 from ..models.llama import (LlamaConfig, init_kv_cache_layers,
-                            llama_decode_step_unrolled, llama_prefill_last)
+                            llama_decode_step_unrolled, llama_prefill_chunk,
+                            llama_prefill_last)
 from .executor import Executor, next_bucket
 from .obs import MetricsHook
 from .sampling import sample_tokens
@@ -111,13 +112,17 @@ class GenerationRequest:
 
 
 class _Slot:
-    __slots__ = ("request", "length", "remaining", "pages")
+    __slots__ = ("request", "length", "remaining", "pages", "chunking")
 
     def __init__(self):
         self.request: Optional[GenerationRequest] = None
         self.length = 0
         self.remaining = 0
         self.pages: Optional[List[int]] = None  # paged engine: owned page ids
+        # chunked prefill in progress: the slot is RESERVED (its cache row
+        # is being filled chunk by chunk) but not yet emitting — excluded
+        # from the free list and from decode demux until the final chunk
+        self.chunking: Optional[GenerationRequest] = None
 
     @property
     def active(self) -> bool:
@@ -188,6 +193,7 @@ class LLMEngine:
         mesh=None,
         budget_bytes: Optional[int] = None,
         tracer=None,
+        chunk_prefill_tokens: int = 0,
     ):
         """mesh: optional jax.sharding.Mesh with a "tp" axis. When given, the
         engine serves TENSOR-PARALLEL: params shard per serving_param_specs
@@ -264,6 +270,20 @@ class LLMEngine:
         self._obs = MetricsHook(self.metrics)
         self.tracer = tracer
         self._batch_seq = itertools.count(1)
+        # chunked prefill (opt-in, 0 = off): prompts in buckets larger than
+        # this are admitted as several bounded chunk dispatches, so decode
+        # blocks and other admissions interleave instead of stalling behind
+        # one huge prefill — the TTFT lever under mixed traffic. The chunk
+        # size must divide every bucket it splits (power-of-two sizes do).
+        self.chunk_prefill_tokens = max(0, int(chunk_prefill_tokens))
+        if self.chunk_prefill_tokens:
+            for bucket in self.prefill_buckets:
+                if (bucket > self.chunk_prefill_tokens
+                        and bucket % self.chunk_prefill_tokens):
+                    raise ValueError(
+                        f"chunk_prefill_tokens={self.chunk_prefill_tokens} "
+                        f"must divide prefill bucket {bucket}")
+        self._chunk_jobs: "collections.deque" = collections.deque()
 
         # in-flight dispatches awaiting host sync, processed FIFO:
         #   ("decode", out_tokens [B, M] future, [(slot_idx, request)], M)
@@ -440,13 +460,22 @@ class LLMEngine:
                 target = (max(self.prefill_buckets) if grow
                           else min(self.prefill_buckets))
                 self._grow_cache(target + 1)
+            chunk = self.chunk_prefill_tokens
             for bucket in self.prefill_buckets:
                 # a bucket is compilable once it fits the allocated cache
-                # (bucket == cache uses the full-row splice branch)
-                if bucket <= self._cache_len:
+                # (bucket == cache uses the full-row splice branch); buckets
+                # routed to the chunk path skip the (dead) fused program
+                if bucket <= self._cache_len and not (chunk and bucket > chunk):
                     self._prefill_program(bucket, 1)
                     if self.logger is not None:
                         self.logger.debugf("warmed prefill bucket %d", bucket)
+            if chunk and any(b > chunk for b in self.prefill_buckets):
+                # chunk-program shapes depend on (chunk, K) only; warm the
+                # first/middle/final variants the first long prompt hits
+                self._chunk_program(chunk, 1, first=True, final=False)
+                self._chunk_program(chunk, 1, first=False, final=True)
+                if any(b > 2 * chunk for b in self.prefill_buckets):
+                    self._chunk_program(chunk, 1, first=False, final=False)
             self._decode_program()
             if self.decode_block_size > 1:  # the adaptive short-block variant
                 self._decode_program(max(1, self.decode_block_size // 2))
@@ -521,6 +550,162 @@ class LLMEngine:
             self._prefill_fn(bucket, K),
             args, donate_argnums=(1, 2, 6, 7, 8))
 
+    def _chunk_fn(self, chunk: int, K: int, first: bool, final: bool):
+        """One chunked-prefill dispatch: process tokens [K, chunk] at
+        absolute positions [start..start+chunk) against the live cache rows
+        (llama_prefill_chunk), fold this chunk's last-position logits into
+        the carried `selected` buffer (a short row's last token may fall in
+        ANY chunk), and on the first/final chunk handle slot parking /
+        sampling+splice."""
+        cfg = self.cfg
+        jnp = self._jnp
+        top_k = self.top_k
+
+        def run_chunk(params, k_cache, v_cache, ctokens, cpositions, slots,
+                      lengths, start, selected, tokens, positions, temps,
+                      new_temps, rng):
+            # start is a traced scalar; chunk/K are static
+            k_cache = tuple(_pin_standard_layout(k) for k in k_cache)
+            v_cache = tuple(_pin_standard_layout(v) for v in v_cache)
+            logits, k_cache, v_cache = llama_prefill_chunk(
+                params, cfg, ctokens, cpositions, k_cache, v_cache, slots,
+                project_last=jnp.clip(lengths - 1 - start, 0, chunk - 1))
+            in_chunk = ((lengths - 1 >= start)
+                        & (lengths - 1 < start + chunk))       # [K]
+            selected = jnp.where(in_chunk[:, None], logits, selected)
+            if first:
+                # PARK the reserved slots' decode positions at the cache
+                # tail: decode blocks interleaving with later chunks write
+                # their lock-step junk there, never inside the prompt range
+                park = k_cache[0].shape[-1] - 1
+                positions = positions.at[slots].set(park)
+            if final:
+                first_tok, rng = sample_tokens(selected, rng, new_temps,
+                                               top_k=top_k)
+                tokens = tokens.at[slots].set(first_tok)
+                positions = positions.at[slots].set(lengths)
+                temps = temps.at[slots].set(new_temps)
+            else:
+                first_tok = selected[:, 0].astype(jnp.int32)  # unused filler
+            k_cache = tuple(_pin_standard_layout(k) for k in k_cache)
+            v_cache = tuple(_pin_standard_layout(v) for v in v_cache)
+            return (k_cache, v_cache, selected, tokens, positions, temps,
+                    rng, first_tok)
+
+        return run_chunk
+
+    def _chunk_program(self, chunk: int, K: int, first: bool, final: bool):
+        jnp = self._jnp
+        args = (self.params, self.k_cache, self.v_cache,
+                jnp.zeros((K, chunk), dtype=jnp.int32),
+                jnp.zeros((K, chunk), dtype=jnp.int32),
+                jnp.zeros((K,), dtype=jnp.int32),
+                jnp.ones((K,), dtype=jnp.int32),
+                jnp.zeros((), dtype=jnp.int32),
+                jnp.zeros((K, self.cfg.vocab_size), dtype=jnp.float32),
+                self._tokens, self._positions, self._temps,
+                jnp.zeros((K,), dtype=jnp.float32), self.rng)
+        name = (f"llama-chunk-{chunk}x{K}"
+                f"{'-first' if first else ''}{'-final' if final else ''}"
+                f"-S{self._cache_len}")
+        return self.executor.compile(
+            name, self._chunk_fn(chunk, K, first, final), args,
+            donate_argnums=(1, 2, 8, 9, 10, 11))
+
+    def _start_chunk_job(self, bucket: int, slots_idx: List[int],
+                         batch: List[GenerationRequest]) -> None:
+        """Prep + dispatch the FIRST chunk synchronously (its parking write
+        must land before any later decode dispatch), then register the job.
+        Host-prep failures before the dispatch leave no reservation behind,
+        so _admit's per-wave handler semantics hold unchanged."""
+        import numpy as np
+
+        jnp = self._jnp
+        if bucket + 1 > self._cache_len:
+            self._grow_cache(bucket + 1)
+        ptokens, lengths, new_temps = self._prep_admission(bucket, batch)
+        job = {
+            "batch": batch, "slots_idx": slots_idx, "bucket": bucket,
+            "chunk": self.chunk_prefill_tokens, "next_start": 0,
+            "ptokens": np.asarray(ptokens), "lengths": lengths,
+            "new_temps": new_temps,
+            "selected": jnp.zeros((len(batch), self.cfg.vocab_size),
+                                  dtype=jnp.float32),
+        }
+        self._dispatch_chunk(job)  # chunk 1 parks the positions
+        now = time.time()
+        for row, request in enumerate(batch):
+            request.admitted_at = now
+            self._obs.hist("app_tpu_queue_wait_seconds",
+                           now - request.enqueued_at)
+            self.slots[slots_idx[row]].chunking = request
+        self._chunk_jobs.append(job)
+
+    def _advance_chunk_job(self) -> None:
+        """Dispatch ONE chunk of the oldest job; decode dispatches fill the
+        pipeline between calls, which is the whole point."""
+        if not self._chunk_jobs:
+            return
+        job = self._chunk_jobs[0]
+        if all(r.cancelled.is_set() for r in job["batch"]):
+            self._abort_chunk_job(job, None)
+            self._chunk_jobs.popleft()
+            return
+        final = self._dispatch_chunk(job)
+        if final:
+            self._chunk_jobs.popleft()
+            self._finish_chunk_job(job)
+
+    def _dispatch_chunk(self, job) -> bool:
+        """Run the job's next chunk program; returns True when it was the
+        final chunk (job['first_tok'] then holds the sampled tokens)."""
+        import numpy as np
+
+        jnp = self._jnp
+        batch = job["batch"]
+        K = len(batch)
+        chunk = job["chunk"]
+        start = job["next_start"]
+        final = start + chunk >= job["bucket"]
+        ctokens = job["ptokens"][:, start:start + chunk]
+        cpositions = np.broadcast_to(
+            np.arange(start, start + chunk, dtype=np.int32)[None, :],
+            (K, chunk))
+        program = self._chunk_program(chunk, K, first=(start == 0),
+                                      final=final)
+        try:
+            (self.k_cache, self.v_cache, job["selected"], self._tokens,
+             self._positions, self._temps, self.rng, first_tok) = program(
+                self.params, self.k_cache, self.v_cache,
+                jnp.asarray(ctokens), jnp.asarray(cpositions),
+                jnp.asarray(np.asarray(job["slots_idx"], dtype=np.int32)),
+                jnp.asarray(job["lengths"]),
+                jnp.asarray(start, dtype=jnp.int32), job["selected"],
+                self._tokens, self._positions, self._temps,
+                jnp.asarray(job["new_temps"]), self.rng)
+        except Exception as exc:
+            raise CacheLostError(f"chunk prefill dispatch failed: {exc}") from exc
+        job["next_start"] = start + chunk
+        job["first_tok"] = first_tok
+        return final
+
+    def _finish_chunk_job(self, job) -> None:
+        for slot_idx in job["slots_idx"]:
+            self.slots[slot_idx].chunking = None
+        batch_id = next(self._batch_seq)
+        dspan = self._dispatch_span(
+            "tpu.prefill", batch_id,
+            **{"batch.size": len(job["batch"]),
+               "tpu.prefill_bucket": job["bucket"], "tpu.chunked": True})
+        self._bind_slots(job["slots_idx"], job["batch"], job["first_tok"],
+                         job["bucket"], batch_id, dspan)
+
+    def _abort_chunk_job(self, job, exc: Optional[BaseException]) -> None:
+        for slot_idx in job["slots_idx"]:
+            self.slots[slot_idx].chunking = None
+        for request in job["batch"]:
+            self._fail_request(request, exc)
+
     def _decode_fn(self, block: int):
         cfg = self.cfg
         top_k = self.top_k
@@ -576,12 +761,16 @@ class LLMEngine:
             try:
                 with self._state_lock:
                     self._admit()
+                    # one chunk per iteration: decode dispatches below and
+                    # the next iteration's admissions interleave with a
+                    # long prompt's remaining chunks
+                    self._advance_chunk_job()
                     any_active = any(slot.active for slot in self.slots)
                     while any_active and len(self._inflight) < self.pipeline_depth:
                         self._dispatch_decode()
                 if self._inflight:
                     self._sync_oldest()
-                else:
+                elif not self._chunk_jobs:
                     self._wake.wait(timeout=0.05)
                     self._wake.clear()
             except Exception as exc:  # noqa: BLE001 - fail active requests, keep serving
@@ -596,6 +785,8 @@ class LLMEngine:
             except Exception as exc:  # noqa: BLE001
                 self._reset_device_state(exc)
         stop_exc = RuntimeError("engine stopped")
+        while self._chunk_jobs:  # mid-prefill requests must not block clients
+            self._abort_chunk_job(self._chunk_jobs.popleft(), stop_exc)
         for slot in self.slots:
             if slot.active:
                 slot.request.error = stop_exc
@@ -608,8 +799,11 @@ class LLMEngine:
         max_prefill_batch (0 = unlimited) can cap admission per loop
         round; on this hardware one fused all-slots prefill measured better
         on BOTH TTFT and throughput than chunked admission (chunks queue
-        behind interleaved decode blocks), so unlimited is the default."""
-        free = [i for i, slot in enumerate(self.slots) if not slot.active]
+        behind interleaved decode blocks), so unlimited is the default.
+        With chunk_prefill_tokens set, buckets larger than the chunk size
+        go through the chunk-job path instead of one fused dispatch."""
+        free = [i for i, slot in enumerate(self.slots)
+                if not slot.active and slot.chunking is None]
         if not free:
             return
         cap = min(len(free), self.max_prefill_batch or len(free))
@@ -658,7 +852,11 @@ class LLMEngine:
                     offset += K
                     slots_idx = [next(free_iter) for _ in batch]
                     try:
-                        self._dispatch_prefill(bucket, slots_idx, batch)
+                        if (self.chunk_prefill_tokens
+                                and bucket > self.chunk_prefill_tokens):
+                            self._start_chunk_job(bucket, slots_idx, batch)
+                        else:
+                            self._dispatch_prefill(bucket, slots_idx, batch)
                     except CacheLostError:
                         raise  # device state suspect: caller must reset
                     except Exception as exc:  # noqa: BLE001
@@ -729,9 +927,10 @@ class LLMEngine:
         admitted = []
         now = time.time()
         for row, request in enumerate(batch):
-            request.admitted_at = now  # queue wait ends; prefill in flight
-            self._obs.hist("app_tpu_queue_wait_seconds",
-                           now - request.enqueued_at)
+            if request.admitted_at is None:  # chunk jobs stamped at chunk 1
+                request.admitted_at = now
+                self._obs.hist("app_tpu_queue_wait_seconds",
+                               now - request.enqueued_at)
             slot = self.slots[slots_idx[row]]
             slot.request = request
             # length counts tokens whose KV is in the cache (the prompt); the
@@ -926,6 +1125,8 @@ class LLMEngine:
                     dspan.set_status(False, str(exc))
                     dspan.end()
             self._inflight.clear()
+            while self._chunk_jobs:  # mid-prefill KV rows died with the cache
+                self._abort_chunk_job(self._chunk_jobs.popleft(), exc)
             for slot in self.slots:
                 if slot.active:
                     slot.request.error = exc

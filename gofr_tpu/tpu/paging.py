@@ -92,6 +92,12 @@ class PagedLLMEngine(LLMEngine):
 
     def __init__(self, params, cfg: LlamaConfig, *, page_size: int = 128,
                  n_pages: Optional[int] = None, **kw):
+        if kw.get("chunk_prefill_tokens"):
+            # the chunk path assumes per-layer dense slot-row caches; over
+            # the stacked page pool it would scatter prompt KV into
+            # arbitrary pages — reject loudly rather than corrupt
+            raise ValueError("chunked prefill is not supported by the paged "
+                             "engine yet (dense LLMEngine only)")
         self.page_size = page_size
         self._requested_pages = n_pages
         # set pre-super: _init_device_state runs inside super().__init__

@@ -1,0 +1,150 @@
+"""Chunked prefill: numerics parity with fused admission + interleaving.
+
+Opt-in engine mode (chunk_prefill_tokens > 0): a long prompt is admitted
+as several bounded chunk dispatches against the live cache rows, so decode
+blocks interleave instead of stalling behind one huge prefill — the TTFT
+lever for mixed traffic. These tests pin the hard invariants on CPU:
+token-for-token parity with the fused path (including prompts whose last
+token falls in an EARLY chunk), and correctness while another request is
+mid-decode (parked positions keep lock-step junk out of the prompt range).
+"""
+
+import time
+
+import pytest
+
+from gofr_tpu.logging import MockLogger
+from gofr_tpu.models.llama import LlamaConfig, llama_init
+from gofr_tpu.tpu.engine import LLMEngine
+
+CFG = LlamaConfig.debug()
+
+
+def _make(chunk=0, **kw):
+    params = llama_init(CFG, seed=0)
+    defaults = dict(n_slots=4, max_seq_len=128, prefill_buckets=(8, 32),
+                    decode_block_size=4, logger=MockLogger())
+    defaults.update(kw)
+    eng = LLMEngine(params, CFG, chunk_prefill_tokens=chunk, **defaults)
+    eng.start()
+    return eng
+
+
+PROMPTS = [
+    list(range(1, 4)),      # len 3: bucket 8, below chunk size — fused path
+    list(range(1, 21)),     # len 20: bucket 32, last token in chunk 3 of 4
+    list(range(1, 31)),     # len 30: bucket 32, last token in final chunk
+    list(range(40, 49)),    # len 9: bucket 32 via... no, bucket 16 absent ->
+                            # next_bucket gives 32; last token in chunk 2
+]
+
+
+def test_chunked_matches_fused_token_for_token():
+    fused = _make(chunk=0)
+    try:
+        want = [fused.generate(p, max_new_tokens=8, temperature=0.0)
+                for p in PROMPTS]
+    finally:
+        fused.stop()
+
+    chunked = _make(chunk=8)
+    try:
+        got = [chunked.generate(p, max_new_tokens=8, temperature=0.0)
+               for p in PROMPTS]
+    finally:
+        chunked.stop()
+    assert got == want
+
+
+def test_chunked_admission_during_active_decode():
+    """A chunked admission lands while another request is mid-decode: the
+    decoding request's output must be untouched (parked positions keep the
+    interleaved lock-step junk out of the new prompt's range) and the new
+    request must match the fused engine."""
+    fused = _make(chunk=0)
+    try:
+        want_long = fused.generate([5, 6, 7], max_new_tokens=40,
+                                   temperature=0.0)
+        want_new = fused.generate(list(range(1, 25)), max_new_tokens=8,
+                                  temperature=0.0)
+    finally:
+        fused.stop()
+
+    eng = _make(chunk=8, decode_block_size=2)
+    try:
+        long_req = eng.submit([5, 6, 7], max_new_tokens=40, temperature=0.0)
+        while long_req.generated < 4:   # ensure decode is genuinely running
+            time.sleep(0.01)
+        new_req = eng.submit(list(range(1, 25)), max_new_tokens=8,
+                             temperature=0.0)
+        assert new_req.result(timeout_s=120) == want_new
+        assert long_req.result(timeout_s=120) == want_long
+    finally:
+        eng.stop()
+
+
+def test_chunked_queue_wait_stamped_once():
+    """admitted_at is stamped at the FIRST chunk dispatch (queue wait ends
+    there) and never overwritten by the final chunk's slot binding."""
+    eng = _make(chunk=8)
+    try:
+        req = eng.submit(list(range(1, 30)), max_new_tokens=3,
+                         temperature=0.0)
+        req.result(timeout_s=120)
+        assert req.admitted_at is not None
+        assert req.admitted_at <= req.first_token_at
+        # the stamp predates the multi-chunk prefill's completion; a
+        # re-stamp at binding would place it at/after first_token_at's sync
+        hist = eng.metrics.get("app_tpu_queue_wait_seconds") if eng.metrics else None
+    finally:
+        eng.stop()
+
+
+def test_paged_engine_rejects_chunking():
+    from gofr_tpu.tpu.paging import PagedLLMEngine
+
+    params = llama_init(CFG, seed=0)
+    with pytest.raises(ValueError, match="not supported by the paged"):
+        PagedLLMEngine(params, CFG, n_slots=2, max_seq_len=64, page_size=8,
+                       chunk_prefill_tokens=8, logger=MockLogger())
+
+
+def test_chunk_warmup_compiles_variants():
+    """Warmup pre-compiles the chunk variants (first/middle/final) so the
+    first long prompt pays no serving-loop JIT stall."""
+    eng = _make(chunk=8)
+    try:
+        eng.warmup(grow=True)
+        names = list(eng.executor.cache_info())
+        assert any("llama-chunk-8x1-first" in n for n in names)
+        assert any("llama-chunk-8x1-final" in n for n in names)
+        assert any(n.startswith("llama-chunk-8x1-S") for n in names)  # middle
+        # the fused program for the chunk-routed bucket is NOT warmed
+        assert not any("llama-prefill-32x" in n for n in names)
+    finally:
+        eng.stop()
+
+
+def test_chunk_size_must_divide_buckets():
+    params = llama_init(CFG, seed=0)
+    with pytest.raises(ValueError, match="must divide"):
+        LLMEngine(params, CFG, n_slots=2, max_seq_len=64,
+                  prefill_buckets=(8, 24), chunk_prefill_tokens=8 + 8,
+                  logger=MockLogger())
+
+
+def test_chunked_stop_unblocks_mid_prefill_clients():
+    """stop() while a chunk job is mid-flight must fail its requests, not
+    strand their clients."""
+    eng = _make(chunk=8)
+    try:
+        reqs = [eng.submit(list(range(1, 30)), max_new_tokens=4,
+                           temperature=0.0) for _ in range(3)]
+    finally:
+        eng.stop()
+    for req in reqs:
+        try:
+            out = req.result(timeout_s=30)
+            assert len(out) <= 4  # finished before the stop: also fine
+        except RuntimeError:
+            pass  # "engine stopped" — the required non-hang outcome
