@@ -1,0 +1,39 @@
+"""Standalone gRPC server example.
+
+Mirrors the reference's examples/grpc-server (main.go + grpc/server.go:13-22):
+a HelloService with SayHello registered on the App, served on GRPC_PORT with
+the framework's logging/recovery/tracing interceptors. The reference
+generates protobuf stubs; here the service is a GenericService (JSON wire
+by default — a protobuf serializer/deserializer pair can be passed instead,
+see gofr_tpu/grpcx GenericService).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from gofr_tpu import App  # noqa: E402
+from gofr_tpu.grpcx import GenericService  # noqa: E402
+
+
+def say_hello(ctx):
+    body = ctx.bind() or {}
+    name = body.get("name") or "World"
+    return {"message": f"Hello {name}!"}
+
+
+def build_app(**kw) -> App:
+    app = App(**kw)
+    app.register_grpc_service(GenericService("HelloService",
+                                             {"SayHello": say_hello}))
+    return app
+
+
+def main() -> None:
+    os.chdir(os.path.dirname(os.path.abspath(__file__)))
+    build_app().run()
+
+
+if __name__ == "__main__":
+    main()

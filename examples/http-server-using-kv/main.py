@@ -1,0 +1,60 @@
+"""HTTP server over the KV store (Redis-shaped datasource).
+
+Mirrors the reference's examples/http-server-using-redis (main.go:16-70):
+set/get handlers plus a pipeline round-trip through ctx.kv — the
+container-wired KV datasource (in-process by default; a gated network
+Redis client when REDIS_HOST is configured, datasource/kvredis.py).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from gofr_tpu import App  # noqa: E402
+from gofr_tpu.http.errors import EntityNotFound, InvalidParam  # noqa: E402
+
+EXPIRY_S = 5 * 60.0
+
+
+def build_app(**kw) -> App:
+    app = App(**kw)
+
+    @app.post("/kv")
+    def kv_set(ctx):
+        body = ctx.bind()
+        if not isinstance(body, dict) or not body:
+            raise InvalidParam(["body"])
+        for key, value in body.items():
+            ctx.kv.set(key, value, ttl_s=EXPIRY_S)
+        return "Successful"
+
+    @app.get("/kv/{key}")
+    def kv_get(ctx):
+        key = ctx.path_param("key")
+        value = ctx.kv.get(key)
+        if value is None:
+            raise EntityNotFound("key", key)
+        return {key: value}
+
+    @app.get("/kv-pipeline")
+    def kv_pipeline(ctx):
+        # queue several commands, apply atomically, read the result back —
+        # the reference's RedisPipelineHandler round-trip (main.go:57-70)
+        pipe = ctx.kv.pipeline()
+        pipe.set("testKey1", "testValue1", ttl_s=EXPIRY_S)
+        pipe.hset("testHash", "field1", "value1")
+        pipe.exec()
+        return {"testKey1": ctx.kv.get("testKey1"),
+                "testHash.field1": ctx.kv.hget("testHash", "field1")}
+
+    return app
+
+
+def main() -> None:
+    os.chdir(os.path.dirname(os.path.abspath(__file__)))
+    build_app().run()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,62 @@
+"""Custom user metrics on the framework's metrics manager.
+
+Mirrors the reference's examples/using-custom-metrics (main.go:22-60): an
+e-commerce store registering all four instrument kinds at boot, recording
+them from handlers via ctx.metrics, exposed in Prometheus text on the
+metrics port alongside the framework's own instruments.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from gofr_tpu import App  # noqa: E402
+
+TRANSACTION_SUCCESS = "transaction_success"
+TRANSACTION_TIME = "transaction_time"
+TOTAL_CREDIT_DAY_SALES = "total_credit_day_sale"
+PRODUCT_STOCK = "product_stock"
+
+
+def build_app(**kw) -> App:
+    app = App(**kw)
+    metrics = app.container.metrics_manager
+    metrics.new_counter(TRANSACTION_SUCCESS,
+                        "count of successful transactions")
+    metrics.new_updown_counter(TOTAL_CREDIT_DAY_SALES,
+                               "total credit sales in a day")
+    metrics.new_gauge(PRODUCT_STOCK, "number of products in stock")
+    metrics.new_histogram(TRANSACTION_TIME, "time taken by a transaction",
+                          buckets=(5, 10, 15, 20, 25, 35))
+
+    @app.post("/transaction")
+    def transaction(ctx):
+        started = time.time()
+        # ... transaction logic ...
+        ctx.metrics().increment_counter(TRANSACTION_SUCCESS)
+        ctx.metrics().record_histogram(TRANSACTION_TIME,
+                                     (time.time() - started) * 1e3)
+        ctx.metrics().delta_updown_counter(TOTAL_CREDIT_DAY_SALES, 1000,
+                                         sale_type="credit")
+        ctx.metrics().set_gauge(PRODUCT_STOCK, 10)
+        return "Transaction Successful"
+
+    @app.post("/return")
+    def sales_return(ctx):
+        ctx.metrics().delta_updown_counter(TOTAL_CREDIT_DAY_SALES, -1000,
+                                         sale_type="credit_return")
+        ctx.metrics().set_gauge(PRODUCT_STOCK, 50)
+        return "Return Successful"
+
+    return app
+
+
+def main() -> None:
+    os.chdir(os.path.dirname(os.path.abspath(__file__)))
+    build_app().run()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,58 @@
+"""Plain pub/sub subscriber app.
+
+Mirrors the reference's examples/using-subscriber (main.go:9-46): two topic
+subscriptions binding JSON payloads, logging them, and committing on
+success (nil return). Processed records land in the KV store so the
+integration test (and the /processed route) can observe consumption.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from gofr_tpu import App  # noqa: E402
+
+
+def build_app(**kw) -> App:
+    app = App(**kw)
+
+    @app.subscribe("products")
+    def on_product(ctx):
+        info = ctx.bind()
+        if not isinstance(info, dict) or "productId" not in info:
+            # malformed payload: log and commit (returning None), never
+            # redeliver a poison message (reference main.go:18-22)
+            ctx.logger.errorf("malformed product payload: %r", info)
+            return None
+        ctx.logger.infof("Received product %s", info)
+        ctx.kv.hset("processed:products", str(info["productId"]),
+                    info.get("price"))
+        return None
+
+    @app.subscribe("order-logs")
+    def on_order(ctx):
+        info = ctx.bind()
+        if not isinstance(info, dict) or "orderId" not in info:
+            ctx.logger.errorf("malformed order payload: %r", info)
+            return None
+        ctx.logger.infof("Received order %s", info)
+        ctx.kv.hset("processed:orders", str(info["orderId"]),
+                    info.get("status"))
+        return None
+
+    @app.get("/processed")
+    def processed(ctx):
+        return {"products": ctx.kv.hgetall("processed:products"),
+                "orders": ctx.kv.hgetall("processed:orders")}
+
+    return app
+
+
+def main() -> None:
+    os.chdir(os.path.dirname(os.path.abspath(__file__)))
+    build_app().run()
+
+
+if __name__ == "__main__":
+    main()

@@ -414,9 +414,11 @@ def llama_prefill_chunk(params, cfg: LlamaConfig, tokens, positions,
     ids. Gathers the K rows, runs the cache-aware attention for the chunk,
     scatters the rows back.
 
-    project_last: None for intermediate chunks (no lm_head work at all);
-    an int32 [K] of within-chunk last indices for the FINAL chunk —
-    gathers those hidden rows and projects [K, V] logits.
+    project_last: int32 [K] of within-chunk last indices — gathers those
+    hidden rows and projects [K, V] logits. The engine passes it for EVERY
+    chunk (a short row's true last position may fall in any chunk; the
+    carried `selected` buffer keeps the right one). None skips the lm_head
+    projection entirely for callers that only need the cache side effect.
 
     This is the building block for chunked prefill: a long prompt is
     admitted as several bounded dispatches so decode blocks (and other

@@ -86,16 +86,23 @@ def test_chunked_admission_during_active_decode():
 def test_chunked_queue_wait_stamped_once():
     """admitted_at is stamped at the FIRST chunk dispatch (queue wait ends
     there) and never overwritten by the final chunk's slot binding."""
-    eng = _make(chunk=8)
+    from gofr_tpu.metrics import new_metrics_manager
+
+    manager = new_metrics_manager()
+    manager.new_histogram("app_tpu_queue_wait_seconds",
+                          "submit-to-admission wait", (0.01, 0.1, 1, 10))
+    eng = _make(chunk=8, metrics=manager)
     try:
         req = eng.submit(list(range(1, 30)), max_new_tokens=3,
                          temperature=0.0)
         req.result(timeout_s=120)
         assert req.admitted_at is not None
         assert req.admitted_at <= req.first_token_at
-        # the stamp predates the multi-chunk prefill's completion; a
-        # re-stamp at binding would place it at/after first_token_at's sync
-        hist = eng.metrics.get("app_tpu_queue_wait_seconds") if eng.metrics else None
+        # exactly ONE queue-wait observation: a re-stamp at final-chunk
+        # binding would both overwrite admitted_at and double the histogram
+        hist = eng.metrics.get("app_tpu_queue_wait_seconds")
+        assert hist is not None
+        assert sum(e["count"] for e in hist.series.values()) == 1
     finally:
         eng.stop()
 

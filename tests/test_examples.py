@@ -167,3 +167,80 @@ def test_sample_cmd(capsys):
     app2 = module.build_app(config=_cfg())
     rc = app2.run(["count"])
     assert rc == 0
+
+
+def test_grpc_server_example():
+    module = _load("grpc-server")
+    app = module.build_app(config=_cfg(GRPC_PORT="0"))
+    app.start()
+    try:
+        from gofr_tpu.grpcx import GRPCClient
+
+        client = GRPCClient(f"127.0.0.1:{app.grpc_port}")
+        try:
+            assert client.call("HelloService", "SayHello",
+                               {"name": "TPU"}) == {"message": "Hello TPU!"}
+            assert client.call("HelloService", "SayHello",
+                               {}) == {"message": "Hello World!"}
+        finally:
+            client.close()
+    finally:
+        app.shutdown()
+
+
+def test_http_server_using_kv(running):
+    app = running("http-server-using-kv")
+    port = app.http_port
+    status, _ = _call(port, "/kv", "POST", {"greeting": "hello"})
+    assert status == 201
+    status, body = _call(port, "/kv/greeting")
+    assert status == 200 and body["data"] == {"greeting": "hello"}
+    status, _ = _call(port, "/kv/absent")
+    assert status == 404
+    status, _ = _call(port, "/kv", "POST", [])
+    assert status == 400
+    status, body = _call(port, "/kv-pipeline")
+    assert status == 200
+    assert body["data"] == {"testKey1": "testValue1",
+                            "testHash.field1": "value1"}
+
+
+def test_using_custom_metrics(running):
+    app = running("using-custom-metrics")
+    port = app.http_port
+    for _ in range(2):
+        status, _ = _call(port, "/transaction", "POST", {})
+        assert status == 201
+    status, _ = _call(port, "/return", "POST", {})
+    assert status == 201
+    # all four instrument kinds land on the metrics port in Prometheus text
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{app.metrics_port}/metrics")
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        text = resp.read().decode()
+    assert "transaction_success 2.0" in text
+    assert 'total_credit_day_sale{sale_type="credit"} 2000.0' in text
+    assert 'total_credit_day_sale{sale_type="credit_return"} -1000.0' in text
+    assert "product_stock 50.0" in text
+    assert "transaction_time_count 2" in text
+
+
+def test_using_subscriber(running):
+    import time as _time
+
+    app = running("using-subscriber")
+    app.container.pubsub.publish(
+        "products", json.dumps({"productId": "p1", "price": "10"}).encode())
+    app.container.pubsub.publish(
+        "order-logs", json.dumps({"orderId": "o1", "status": "sent"}).encode())
+    app.container.pubsub.publish("products", b"not json {")  # poison: dropped
+    deadline = _time.time() + 10
+    body = {}
+    while _time.time() < deadline:
+        status, body = _call(app.http_port, "/processed")
+        assert status == 200
+        if body["data"]["products"] and body["data"]["orders"]:
+            break
+        _time.sleep(0.05)
+    assert body["data"]["products"] == {"p1": "10"}
+    assert body["data"]["orders"] == {"o1": "sent"}
