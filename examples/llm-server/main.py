@@ -35,6 +35,20 @@ def build_engine(app: App) -> LLMEngine:
     app.add_tpu(tpu)
     preset = app.config.get_or_default("MODEL_PRESET", "debug")
     cfg = PRESETS[preset]()
+    # ATTN_IMPL: xla | flash (prefill / no-cache forward impl)
+    # DECODE_ATTN: xla | kernel (the T=1 cached read; "kernel" streams the
+    # S-minor cache through the Pallas decode kernel, HBM traffic bounded
+    # by live lengths — see ops/decode_attention)
+    import dataclasses
+
+    attn_impl = app.config.get_or_default("ATTN_IMPL", cfg.attn_impl)
+    decode_attn = app.config.get_or_default("DECODE_ATTN", cfg.decode_attn)
+    if attn_impl not in ("xla", "flash"):
+        raise ValueError(f"ATTN_IMPL must be xla|flash, got {attn_impl!r}")
+    if decode_attn not in ("xla", "kernel"):
+        raise ValueError(f"DECODE_ATTN must be xla|kernel, got {decode_attn!r}")
+    cfg = dataclasses.replace(cfg, attn_impl=attn_impl,
+                              decode_attn=decode_attn)
     # VOCAB_PATH deploys a real model vocabulary (JSON {vocab, merges},
     # BPETokenizer.from_file — native merge loop when the C++ lib is built);
     # without it the exact-and-reversible byte tokenizer serves

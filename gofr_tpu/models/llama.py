@@ -37,9 +37,15 @@ class LlamaConfig:
     dtype: str = "bfloat16"
     # "xla" | "flash" — selects the attention impl for the no-cache forward
     # (training/eval) AND the serving prefill (full-window T == S case in
-    # _attention_block); cached decode (T=1) keeps the masked cache read,
-    # whose cost is the roofline-bound cache traffic itself
+    # _attention_block)
     attn_impl: str = "xla"
+    # "xla" | "kernel" — the cached T=1 decode read. "xla" is the masked
+    # einsum over the whole allocated cache; "kernel" is the Pallas
+    # streaming read (ops/decode_attention) whose per-step HBM traffic is
+    # bounded by each row's LIVE length, not the allocated S (the einsum
+    # also reads the S-minor storage well below DMA peak — see the kernel
+    # module docstring for the measured gap)
+    decode_attn: str = "xla"
 
     @property
     def head_dim(self) -> int:
@@ -208,6 +214,16 @@ def _attention_block(x, layer, k_cache_l, v_cache_l, positions, cfg: LlamaConfig
 
         attn = flash_attention(q, k, v, True)  # [B, T, H, dh]
         out = attn.reshape(B, T, H * dh) @ layer["wo"]
+        return out, k_cache_l, v_cache_l
+
+    if T == 1 and cfg.decode_attn == "kernel":
+        from ..ops.decode_attention import decode_attention
+
+        # the scatter above put this step's k/v at `positions`, so the live
+        # window is [0, positions] inclusive — lengths = positions + 1
+        attn = decode_attention(q[:, 0], k_cache_l, v_cache_l,
+                                positions[:, 0] + 1)        # [B, H, dh]
+        out = attn.reshape(B, 1, H * dh) @ layer["wo"]
         return out, k_cache_l, v_cache_l
 
     # GQA attention over the cache: q grouped [B, T, Hkv, G, dh].

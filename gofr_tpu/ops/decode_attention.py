@@ -129,13 +129,24 @@ def decode_attention(q, k_cache, v_cache, lengths, *, block_s: int = 512,
     qg = q.reshape(B, Hkv, G, dh)
     kernel = functools.partial(_decode_kernel, block_s=block_s, n_kv=Hkv,
                                scale=1.0 / math.sqrt(dh))
+
+    def kv_index(b, j, lens):
+        # LIVE-LENGTH DMA CLAMP: blocks past a row's live length re-select
+        # its last live block. Pallas skips the copy when consecutive grid
+        # steps map to the same block, so per-row HBM traffic tracks
+        # ceil(length / block_s) blocks, not S / block_s — dead blocks cost
+        # nothing. Their compute is already skipped via pl.when; which block
+        # sits in VMEM then is irrelevant.
+        last_live = jnp.maximum((lens[b] + block_s - 1) // block_s - 1, 0)
+        return (b, 0, 0, jnp.minimum(j, last_live))
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,  # lengths
         grid=(B, S // block_s),
         in_specs=[
             pl.BlockSpec((1, Hkv, G, dh), lambda b, j, lens: (b, 0, 0, 0)),
-            pl.BlockSpec((1, Hkv, dh, block_s), lambda b, j, lens: (b, 0, 0, j)),
-            pl.BlockSpec((1, Hkv, dh, block_s), lambda b, j, lens: (b, 0, 0, j)),
+            pl.BlockSpec((1, Hkv, dh, block_s), kv_index),
+            pl.BlockSpec((1, Hkv, dh, block_s), kv_index),
         ],
         out_specs=pl.BlockSpec((1, Hkv, G, dh), lambda b, j, lens: (b, 0, 0, 0)),
         scratch_shapes=[

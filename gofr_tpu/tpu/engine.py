@@ -254,6 +254,14 @@ class LLMEngine:
         self._seed = seed
         self._reset_counter = itertools.count(seed)
 
+        # attention impls are part of program identity: the in-memory
+        # compile cache keys on (name, shapes), and an executor shared
+        # across engines with different cfg.attn_impl/decode_attn must not
+        # hand one config the other's compiled program. Prefill names carry
+        # the attn_impl (its T==S window hits the flash branch); decode
+        # names carry decode_attn (its T=1 read hits the kernel branch).
+        self._attn_suffix = "-flash" if cfg.attn_impl == "flash" else ""
+
         self.slots = [_Slot() for _ in range(n_slots)]
         self._pending: "queue.Queue[GenerationRequest]" = queue.Queue()
         # requests admitted from _pending but waiting on a resource the
@@ -546,7 +554,8 @@ class LLMEngine:
                 self._tokens, self._positions, self._temps,
                 jnp.zeros((K,), dtype=jnp.float32), self.rng)
         return self.executor.compile(
-            f"llama-prefill-{bucket}x{K}-S{self._cache_len}",
+            f"llama-prefill-{bucket}x{K}-S{self._cache_len}"
+            f"{self._attn_suffix}",
             self._prefill_fn(bucket, K),
             args, donate_argnums=(1, 2, 6, 7, 8))
 
@@ -751,7 +760,8 @@ class LLMEngine:
         block = block or self.decode_block_size
         args = (self.params, self.k_cache, self.v_cache,
                 self._tokens, self._positions, self._temps, self.rng)
-        name = f"llama-decode-x{block}-S{self._cache_len}"
+        suffix = "-kern" if self.cfg.decode_attn == "kernel" else ""
+        name = f"llama-decode-x{block}-S{self._cache_len}{suffix}"
         return self.executor.compile(name, self._decode_fn(block), args,
                                      donate_argnums=(1, 2))
 

@@ -42,3 +42,52 @@ def test_kernel_rejects_misaligned_block():
     k = jnp.zeros((1, 1, 8, 48))
     with pytest.raises(ValueError, match="divide"):
         decode_attention(q, k, k, jnp.asarray([4], jnp.int32), block_s=32)
+
+
+def test_unrolled_decode_step_kernel_matches_xla():
+    """cfg.decode_attn='kernel' routes the T=1 cached read through the
+    Pallas kernel; greedy decode must match the xla path token-for-token
+    (llama.py _attention_block T==1 branch)."""
+    import dataclasses
+
+    from gofr_tpu.models.llama import (LlamaConfig, init_kv_cache_layers,
+                                       llama_decode_step_unrolled, llama_init,
+                                       llama_prefill_last)
+
+    cfg = LlamaConfig.debug()
+    cfg_k = dataclasses.replace(cfg, decode_attn="kernel")
+    params = llama_init(cfg, seed=0)
+    rng = np.random.default_rng(0)
+    B, T, S = 4, 16, 64
+    toks = jnp.asarray(rng.integers(1, cfg.vocab_size, (B, T)), jnp.int32)
+    pos = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None], (B, T))
+    lengths = jnp.asarray([16, 9, 3, 12], dtype=jnp.int32)
+    k_st = jnp.stack(init_kv_cache_layers(cfg, B, S)[0])
+    v_st = jnp.zeros_like(k_st)
+    logits, k_st, v_st = llama_prefill_last(params, cfg, toks, pos, lengths,
+                                            k_st, v_st)
+    k = tuple(k_st[l] for l in range(cfg.n_layers))
+    v = tuple(v_st[l] for l in range(cfg.n_layers))
+    cur, p = jnp.argmax(logits, -1).astype(jnp.int32), lengths
+    for _ in range(4):
+        l_x, k_x, v_x = llama_decode_step_unrolled(params, cfg, cur, p, k, v)
+        l_k, _, _ = llama_decode_step_unrolled(params, cfg_k, cur, p, k, v)
+        assert jnp.all(jnp.argmax(l_x, -1) == jnp.argmax(l_k, -1))
+        np.testing.assert_allclose(np.asarray(l_k), np.asarray(l_x),
+                                   rtol=0.05, atol=0.05)
+        cur, p, k, v = jnp.argmax(l_x, -1).astype(jnp.int32), p + 1, k_x, v_x
+
+
+def test_live_length_clamp_matches_reference():
+    """Dead blocks re-select the last live block (DMA-skip clamp); numerics
+    must be unchanged for very short lengths in a many-block cache."""
+    rng = np.random.default_rng(2)
+    B, H, Hkv, dh, S = 2, 4, 2, 16, 128
+    q = jnp.asarray(rng.normal(size=(B, H, dh)), dtype=jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, Hkv, dh, S)), dtype=jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, Hkv, dh, S)), dtype=jnp.float32)
+    lens = jnp.asarray([2, 113], dtype=jnp.int32)
+    ref = decode_attention_reference(q, k, v, lens)
+    out = decode_attention(q, k, v, lens, block_s=16)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
