@@ -27,6 +27,7 @@ PRESETS = {
     "debug": LlamaConfig.debug,
     "llama1b": LlamaConfig.llama1b,
     "llama3-8b": LlamaConfig.llama3_8b,
+    "llama3-70b": LlamaConfig.llama3_70b,  # TP_SHARDS=8 territory (config 5)
 }
 
 
@@ -43,12 +44,17 @@ def build_engine(app: App) -> LLMEngine:
 
     attn_impl = app.config.get_or_default("ATTN_IMPL", cfg.attn_impl)
     decode_attn = app.config.get_or_default("DECODE_ATTN", cfg.decode_attn)
+    # KV_DTYPE=int8 halves cache HBM bytes (quantize-on-write, kernel
+    # dequant) — requires DECODE_ATTN=kernel
+    kv_dtype = app.config.get_or_default("KV_DTYPE", "") or None
     if attn_impl not in ("xla", "flash"):
         raise ValueError(f"ATTN_IMPL must be xla|flash, got {attn_impl!r}")
     if decode_attn not in ("xla", "kernel"):
         raise ValueError(f"DECODE_ATTN must be xla|kernel, got {decode_attn!r}")
+    if kv_dtype not in (None, "int8"):
+        raise ValueError(f"KV_DTYPE must be int8 or unset, got {kv_dtype!r}")
     cfg = dataclasses.replace(cfg, attn_impl=attn_impl,
-                              decode_attn=decode_attn)
+                              decode_attn=decode_attn, kv_dtype=kv_dtype)
     # VOCAB_PATH deploys a real model vocabulary (JSON {vocab, merges},
     # BPETokenizer.from_file — native merge loop when the C++ lib is built);
     # without it the exact-and-reversible byte tokenizer serves

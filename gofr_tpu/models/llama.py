@@ -46,6 +46,12 @@ class LlamaConfig:
     # also reads the S-minor storage well below DMA peak — see the kernel
     # module docstring for the measured gap)
     decode_attn: str = "xla"
+    # None (= cfg.dtype) | "int8" — the serving KV cache's storage dtype.
+    # int8 halves cache HBM bytes (the decode bandwidth bound) and doubles
+    # context capacity per GiB; values quantize on write with per-token
+    # per-head scales and dequantize inside the decode kernel's dots
+    # (requires decode_attn == "kernel"; dense engine only)
+    kv_dtype: Optional[str] = None
 
     @property
     def head_dim(self) -> int:
@@ -91,7 +97,7 @@ def _np_dtype(name: str):
     import jax.numpy as jnp
 
     return {"bfloat16": jnp.bfloat16, "float32": jnp.float32,
-            "float16": jnp.float16}[name]
+            "float16": jnp.float16, "int8": jnp.int8}[name]
 
 
 def llama_init(cfg: LlamaConfig, seed: int = 0) -> Dict[str, Any]:
@@ -379,6 +385,69 @@ def llama_decode_step_unrolled(params, cfg: LlamaConfig, tokens, positions,
     x = rms_norm(x, params["final_norm"], cfg.rms_eps)
     logits = (x[:, 0] @ params["lm_head"]).astype(jnp.float32)
     return logits, tuple(k_out), tuple(v_out)
+
+
+def init_kv_scale_layers(cfg: LlamaConfig, batch: int,
+                         seq_len: Optional[int] = None) -> Tuple[Tuple, Tuple]:
+    """Per-layer (k_scale, v_scale) buffers for the int8 cache: tuples of
+    L arrays [B, Hkv, S] float32 (dequant value = int8 * scale). ~6% of the
+    int8 cache's bytes at dh=64."""
+    import jax.numpy as jnp
+
+    S = seq_len or cfg.max_seq_len
+    shape = (batch, cfg.n_kv_heads, S)
+    k = tuple(jnp.zeros(shape, dtype=jnp.float32) for _ in range(cfg.n_layers))
+    v = tuple(jnp.zeros(shape, dtype=jnp.float32) for _ in range(cfg.n_layers))
+    return k, v
+
+
+def llama_decode_step_unrolled_q8(params, cfg: LlamaConfig, tokens, positions,
+                                  k_layers, v_layers, ks_layers, vs_layers):
+    """One decode step over INT8 per-layer caches with per-token scales.
+
+    tokens/positions: [B]; k/v_layers: tuples of [B, Hkv, dh, S] int8;
+    ks/vs_layers: tuples of [B, Hkv, S] float32 scales. Returns
+    (logits [B, V] f32, k_layers, v_layers, ks_layers, vs_layers).
+
+    The cache crosses HBM as int8 — half the bf16 bytes, so the
+    bandwidth-bound decode step's cache term halves. The new token's K/V
+    quantize on write (symmetric per-token-per-head, ops/decode_attention.
+    quantize_kv); the read is the Pallas kernel with dequant FOLDED into
+    its two dots (k's scale multiplies scores, v's folds into probs).
+    Requires cfg.decode_attn == "kernel" — there is no efficient XLA-einsum
+    dequant read (it would materialize the full cache in bf16).
+    """
+    from ..ops.decode_attention import decode_attention, quantize_kv
+
+    B = tokens.shape[0]
+    H, Hkv, dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    x = params["tok_emb"][tokens][:, None]                 # [B, 1, D]
+    pos_grid = positions[:, None]
+    batch_idx = jnp.arange(B)
+    k_out, v_out = list(k_layers), list(v_layers)
+    ks_out, vs_out = list(ks_layers), list(vs_layers)
+    for l in range(cfg.n_layers):
+        layer = jax.tree_util.tree_map(lambda w: w[l], params["layers"])
+        normed = rms_norm(x, layer["attn_norm"], cfg.rms_eps)
+        q = (normed @ layer["wq"]).reshape(B, 1, H, dh)
+        k = (normed @ layer["wk"]).reshape(B, 1, Hkv, dh)
+        v = (normed @ layer["wv"]).reshape(B, 1, Hkv, dh)
+        q = rope(q, pos_grid, cfg.rope_theta)
+        k = rope(k, pos_grid, cfg.rope_theta)
+        k8, ks = quantize_kv(k[:, 0], axis=-1)             # [B,Hkv,dh], [B,Hkv]
+        v8, vs = quantize_kv(v[:, 0], axis=-1)
+        k_out[l] = k_out[l].at[batch_idx, :, :, positions].set(k8)
+        v_out[l] = v_out[l].at[batch_idx, :, :, positions].set(v8)
+        ks_out[l] = ks_out[l].at[batch_idx, :, positions].set(ks)
+        vs_out[l] = vs_out[l].at[batch_idx, :, positions].set(vs)
+        attn = decode_attention(q[:, 0], k_out[l], v_out[l], positions + 1,
+                                ks_out[l], vs_out[l])      # [B, H, dh]
+        x = x + attn.reshape(B, 1, H * dh) @ layer["wo"]
+        x = x + _ffn_block(x, layer, cfg)
+    x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+    logits = (x[:, 0] @ params["lm_head"]).astype(jnp.float32)
+    return (logits, tuple(k_out), tuple(v_out), tuple(ks_out),
+            tuple(vs_out))
 
 
 def llama_decode_step_inplace(params, cfg: LlamaConfig, tokens, positions,

@@ -40,9 +40,13 @@ import jax.numpy as jnp
 DEFAULT_MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
 
 
-def decode_attention_reference(q, k_cache, v_cache, lengths):
+def decode_attention_reference(q, k_cache, v_cache, lengths, k_scale=None,
+                               v_scale=None):
     """Oracle in XLA. q: [B, H, dh]; k/v_cache: [B, Hkv, dh, S] (S-minor);
     lengths: [B] live positions (query attends [0, lengths)). -> [B, H, dh].
+
+    k/v_scale: optional [B, Hkv, S] per-token dequant scales for int8
+    caches (dequant value = int8 * scale).
 
     A row with lengths[b] == 0 returns ZEROS (there is nothing to attend);
     a plain masked softmax would instead emit the uniform mean of junk v —
@@ -50,21 +54,39 @@ def decode_attention_reference(q, k_cache, v_cache, lengths):
     B, H, dh = q.shape
     Hkv, S = k_cache.shape[1], k_cache.shape[-1]
     G = H // Hkv
+    k = k_cache.astype(jnp.float32)
+    v = v_cache.astype(jnp.float32)
+    if k_scale is not None:
+        k = k * k_scale[:, :, None, :].astype(jnp.float32)
+    if v_scale is not None:
+        v = v * v_scale[:, :, None, :].astype(jnp.float32)
     qg = q.reshape(B, Hkv, G, dh).astype(jnp.float32)
-    s = jnp.einsum("bhgd,bhds->bhgs", qg,
-                   k_cache.astype(jnp.float32)) / math.sqrt(dh)
+    s = jnp.einsum("bhgd,bhds->bhgs", qg, k) / math.sqrt(dh)
     pos = jnp.arange(S)[None, :]
     s = jnp.where((pos < lengths[:, None])[:, None, None, :], s,
                   DEFAULT_MASK_VALUE)
     p = jax.nn.softmax(s, axis=-1)
-    out = jnp.einsum("bhgs,bhds->bhgd", p, v_cache.astype(jnp.float32))
+    out = jnp.einsum("bhgs,bhds->bhgd", p, v)
     out = jnp.where((lengths > 0)[:, None, None, None], out, 0.0)
     return out.reshape(B, H, dh).astype(q.dtype)
 
 
-def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
-                   *, block_s: int, n_kv: int, scale: float):
+def _decode_kernel(len_ref, *refs, block_s: int, n_kv: int, scale: float,
+                   quantized: bool):
+    """One (b, j) grid step: fold S block j into every head's online softmax.
+
+    quantized=False refs: (q, k, v, o, m, l, acc)
+    quantized=True  refs: (q, k, v, k_scale, v_scale, o, m, l, acc) — k/v are
+    int8; dequant is FOLDED, never materialized: k's per-token scale
+    multiplies the score matrix after the q·k dot (a row scale), and v's
+    folds into the probabilities before the p·v dot."""
     from jax.experimental import pallas as pl
+
+    if quantized:
+        q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr = refs
+        ks_ref = vs_ref = None
 
     b = pl.program_id(0)
     j = pl.program_id(1)                                   # S block (innermost)
@@ -88,8 +110,12 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
             q = q_ref[0, h]                                # [G, dh]
             k = k_ref[0, h]                                # [dh, bs]
             v = v_ref[0, h]
+            if quantized:
+                k = k.astype(jnp.bfloat16)                 # in-VMEM upcast
             s = jax.lax.dot_general(q, k, (((1,), (0,)), ((), ())),
                                     preferred_element_type=jnp.float32) * scale
+            if quantized:
+                s = s * ks_ref[0, h][None, :].astype(jnp.float32)
             s = jnp.where(mask, s, DEFAULT_MASK_VALUE)
             row = slice(h * G, (h + 1) * G)
             m_prev = m_scr[row]
@@ -99,6 +125,9 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
             m_scr[row] = m_new
             l_scr[row] = l_scr[row] * alpha + jnp.sum(p, axis=-1,
                                                       keepdims=True)
+            if quantized:
+                p = p * vs_ref[0, h][None, :].astype(jnp.float32)
+                v = v.astype(jnp.bfloat16)
             pv = jax.lax.dot_general(p.astype(v.dtype), v,
                                      (((1,), (1,)), ((), ())),
                                      preferred_element_type=jnp.float32)
@@ -110,16 +139,23 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
                     ).reshape(Hkv, G, dh).astype(o_ref.dtype)
 
 
-def decode_attention(q, k_cache, v_cache, lengths, *, block_s: int = 512,
-                     interpret=None):
+def decode_attention(q, k_cache, v_cache, lengths, k_scale=None, v_scale=None,
+                     *, block_s: int = 512, interpret=None):
     """Pallas decode attention. q: [B, H, dh]; k/v_cache: [B, Hkv, dh, S];
-    lengths: [B] int32. Returns [B, H, dh] in q.dtype."""
+    lengths: [B] int32. Returns [B, H, dh] in q.dtype.
+
+    k/v_scale: optional [B, Hkv, S] per-token dequant scales — pass both to
+    read int8 caches (the int8 bytes are what cross HBM; dequant folds into
+    the existing dots, see _decode_kernel)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     B, H, dh = q.shape
     Hkv, S = k_cache.shape[1], k_cache.shape[-1]
     G = H // Hkv
+    quantized = k_scale is not None
+    if quantized != (v_scale is not None):
+        raise ValueError("pass both k_scale and v_scale or neither")
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     block_s = min(block_s, S)
@@ -128,7 +164,8 @@ def decode_attention(q, k_cache, v_cache, lengths, *, block_s: int = 512,
 
     qg = q.reshape(B, Hkv, G, dh)
     kernel = functools.partial(_decode_kernel, block_s=block_s, n_kv=Hkv,
-                               scale=1.0 / math.sqrt(dh))
+                               scale=1.0 / math.sqrt(dh),
+                               quantized=quantized)
 
     def kv_index(b, j, lens):
         # LIVE-LENGTH DMA CLAMP: blocks past a row's live length re-select
@@ -140,14 +177,25 @@ def decode_attention(q, k_cache, v_cache, lengths, *, block_s: int = 512,
         last_live = jnp.maximum((lens[b] + block_s - 1) // block_s - 1, 0)
         return (b, 0, 0, jnp.minimum(j, last_live))
 
+    def scale_index(b, j, lens):
+        last_live = jnp.maximum((lens[b] + block_s - 1) // block_s - 1, 0)
+        return (b, 0, jnp.minimum(j, last_live))
+
+    in_specs = [
+        pl.BlockSpec((1, Hkv, G, dh), lambda b, j, lens: (b, 0, 0, 0)),
+        pl.BlockSpec((1, Hkv, dh, block_s), kv_index),
+        pl.BlockSpec((1, Hkv, dh, block_s), kv_index),
+    ]
+    operands = [lengths, qg, k_cache, v_cache]
+    if quantized:
+        in_specs += [pl.BlockSpec((1, Hkv, block_s), scale_index),
+                     pl.BlockSpec((1, Hkv, block_s), scale_index)]
+        operands += [k_scale, v_scale]
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,  # lengths
         grid=(B, S // block_s),
-        in_specs=[
-            pl.BlockSpec((1, Hkv, G, dh), lambda b, j, lens: (b, 0, 0, 0)),
-            pl.BlockSpec((1, Hkv, dh, block_s), kv_index),
-            pl.BlockSpec((1, Hkv, dh, block_s), kv_index),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, Hkv, G, dh), lambda b, j, lens: (b, 0, 0, 0)),
         scratch_shapes=[
             pltpu.VMEM((Hkv * G, 1), jnp.float32),
@@ -160,5 +208,20 @@ def decode_attention(q, k_cache, v_cache, lengths, *, block_s: int = 512,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, Hkv, G, dh), q.dtype),
         interpret=interpret,
-    )(lengths, qg, k_cache, v_cache)
+    )(*operands)
     return out.reshape(B, H, dh)
+
+
+def quantize_kv(x, axis: int = -2):
+    """Symmetric int8 quantization along `axis` (the dh axis of a
+    [..., dh, S]-shaped cache entry): returns (int8 values, scale) with
+    dequant = int8 * scale and scale shaped like x minus `axis`.
+
+    Per-token-per-head scales keep the quantization error of any one token
+    independent of its neighbors — the property that makes int8 KV safe for
+    long-context serving."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=axis, keepdims=True)
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q8 = jnp.clip(jnp.round(x.astype(jnp.float32) / scale), -127, 127
+                  ).astype(jnp.int8)
+    return q8, jnp.squeeze(scale, axis=axis)

@@ -17,7 +17,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Optional, Sequence, Tuple
 
-_DTYPE_BYTES = {"bfloat16": 2, "float16": 2, "float32": 4}
+_DTYPE_BYTES = {"bfloat16": 2, "float16": 2, "float32": 4, "int8": 1}
 
 
 def _dtype_bytes(name: str) -> int:
@@ -126,7 +126,11 @@ def plan_capacity(cfg, n_slots: int, max_seq_len: int,
     requested = (n_slots, max_seq_len)
 
     def peak(slots: int, seq: int) -> Tuple[int, int, int]:
-        cache = kv_cache_bytes(cfg, slots, seq)
+        kv_dtype = getattr(cfg, "kv_dtype", None)
+        cache = kv_cache_bytes(cfg, slots, seq, dtype=kv_dtype)
+        if kv_dtype == "int8":
+            # per-token f32 dequant scales: 2 * [L, B, Hkv, S]
+            cache += (2 * cfg.n_layers * slots * cfg.n_kv_heads * seq * 4)
         # dense decode ping-pongs the scanned cache carries (one extra
         # cache-sized pair); this also covers the smaller one-off grow copy.
         # the paged pool is never carried whole, so it has no such transient

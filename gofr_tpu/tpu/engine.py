@@ -45,7 +45,8 @@ import time
 from typing import Any, Dict, Iterator, List, Optional, Sequence, Set, Tuple
 
 from ..models.llama import (LlamaConfig, init_kv_cache_layers,
-                            llama_decode_step_unrolled, llama_prefill_chunk,
+                            init_kv_scale_layers, llama_decode_step_unrolled,
+                            llama_decode_step_unrolled_q8, llama_prefill_chunk,
                             llama_prefill_last)
 from .executor import Executor, next_bucket
 from .obs import MetricsHook
@@ -262,6 +263,28 @@ class LLMEngine:
         # names carry decode_attn (its T=1 read hits the kernel branch).
         self._attn_suffix = "-flash" if cfg.attn_impl == "flash" else ""
 
+        # int8 KV cache: halves cache HBM traffic (the decode bandwidth
+        # bound) and doubles context per GiB. Quantize-on-write + kernel
+        # dequant only — the XLA einsum read would materialize a bf16 copy
+        if cfg.kv_dtype not in (None, "int8", cfg.dtype):
+            # a float kv_dtype differing from cfg.dtype would make the
+            # capacity plan (which reads kv_dtype) and the allocation
+            # (which uses cfg.dtype) disagree — reject until supported
+            raise ValueError(f"kv_dtype={cfg.kv_dtype!r} not supported; "
+                             f"use None or 'int8'")
+        self._q8 = cfg.kv_dtype == "int8"
+        if self._q8:
+            if cfg.decode_attn != "kernel":
+                raise ValueError("kv_dtype='int8' requires decode_attn="
+                                 "'kernel' (no efficient XLA dequant read)")
+            if mesh is not None:
+                raise ValueError("kv_dtype='int8' with a tp mesh is not "
+                                 "supported yet (scale sharding specs)")
+            if chunk_prefill_tokens:
+                raise ValueError("kv_dtype='int8' with chunked prefill is "
+                                 "not supported yet (chunk reads need a "
+                                 "dequant cached-attention path)")
+
         self.slots = [_Slot() for _ in range(n_slots)]
         self._pending: "queue.Queue[GenerationRequest]" = queue.Queue()
         # requests admitted from _pending but waiting on a resource the
@@ -320,8 +343,13 @@ class LLMEngine:
         # effective on v5e (167 ms/step at B=128/S=1024); separate buffers
         # with an unrolled layer loop run 35 ms/step — see
         # init_kv_cache_layers
-        self.k_cache, self.v_cache = init_kv_cache_layers(self.cfg, B,
-                                                          self._cache_len)
+        self.k_cache, self.v_cache = init_kv_cache_layers(
+            self.cfg, B, self._cache_len,
+            dtype="int8" if self._q8 else None)
+        self.k_scale = self.v_scale = None
+        if self._q8:
+            self.k_scale, self.v_scale = init_kv_scale_layers(
+                self.cfg, B, self._cache_len)
         self._tokens = jnp.zeros((B,), dtype=jnp.int32)
         self._positions = jnp.zeros((B,), dtype=jnp.int32)
         self._temps = jnp.zeros((B,), dtype=jnp.float32)
@@ -361,16 +389,32 @@ class LLMEngine:
         if new_len <= self._cache_len:
             return
         pad = ((0, 0), (0, 0), (0, 0), (0, new_len - self._cache_len))
+        spad = pad[1:]  # scale buffers are [B, Hkv, S]
 
         def grow_fn(k_layers, v_layers):
             return (tuple(_pin_standard_layout(jnp.pad(k, pad)) for k in k_layers),
                     tuple(_pin_standard_layout(jnp.pad(v, pad)) for v in v_layers))
 
-        program = self.executor.compile(
-            f"kv-grow-{self._cache_len}-to-{new_len}", grow_fn,
-            (self.k_cache, self.v_cache), donate_argnums=(0, 1))
+        def grow_fn_q8(k_layers, v_layers, ks_layers, vs_layers):
+            k, v = grow_fn(k_layers, v_layers)
+            return (k, v,
+                    tuple(jnp.pad(s, spad) for s in ks_layers),
+                    tuple(jnp.pad(s, spad) for s in vs_layers))
+
         try:
-            self.k_cache, self.v_cache = program(self.k_cache, self.v_cache)
+            if self._q8:
+                program = self.executor.compile(
+                    f"kv-grow-q8-{self._cache_len}-to-{new_len}", grow_fn_q8,
+                    (self.k_cache, self.v_cache, self.k_scale, self.v_scale),
+                    donate_argnums=(0, 1, 2, 3))
+                (self.k_cache, self.v_cache, self.k_scale,
+                 self.v_scale) = program(self.k_cache, self.v_cache,
+                                         self.k_scale, self.v_scale)
+            else:
+                program = self.executor.compile(
+                    f"kv-grow-{self._cache_len}-to-{new_len}", grow_fn,
+                    (self.k_cache, self.v_cache), donate_argnums=(0, 1))
+                self.k_cache, self.v_cache = program(self.k_cache, self.v_cache)
         except Exception as exc:
             # the grow program consumed the donated caches: this is a
             # device-state loss, not a host-prep failure — _admit's per-wave
@@ -545,8 +589,80 @@ class LLMEngine:
 
         return prefill
 
+    def _prefill_fn_q8(self, bucket: int, K: int):
+        """Fused K-way admission into the INT8 cache: the window forward
+        runs full-precision into bf16 temps (prefill accuracy is free —
+        the temps never hit HBM as cache), then values quantize per
+        token/head at the splice.
+
+        MIRRORS _prefill_fn with (k_scale, v_scale) threaded through; a
+        behavioral change to the splice/sampling there must land here too
+        (kept separate so each program's donated signature stays legible).
+        """
+        cfg = self.cfg
+        jnp = self._jnp
+        top_k = self.top_k
+
+        def prefill(params, k_cache, v_cache, k_scale, v_scale, ptokens,
+                    slots, lengths, tokens, positions, temps, new_temps, rng):
+            from ..ops.decode_attention import quantize_kv
+
+            L = cfg.n_layers
+            S = k_cache[0].shape[-1]
+            Hkv, dh = cfg.n_kv_heads, cfg.head_dim
+            k_cache = tuple(_pin_standard_layout(k) for k in k_cache)
+            v_cache = tuple(_pin_standard_layout(v) for v in v_cache)
+            from ..models.llama import _np_dtype
+
+            tmp_k = jnp.zeros((L, K, Hkv, dh, bucket), dtype=_np_dtype(cfg.dtype))
+            tmp_v = jnp.zeros_like(tmp_k)
+            tmp_k, tmp_v = _pin_standard_layout(tmp_k, tmp_v)
+            pos_grid = jnp.broadcast_to(
+                jnp.arange(bucket, dtype=jnp.int32)[None, :], (K, bucket))
+            last, tmp_k, tmp_v = llama_prefill_last(
+                params, cfg, ptokens, pos_grid, lengths, tmp_k, tmp_v)
+            k8, ks = quantize_kv(tmp_k, axis=-2)   # [L,K,Hkv,d,b] -> scales [L,K,Hkv,b]
+            v8, vs = quantize_kv(tmp_v, axis=-2)
+            if bucket == S:
+                k_cache = tuple(k_cache[l].at[slots].set(k8[l]) for l in range(L))
+                v_cache = tuple(v_cache[l].at[slots].set(v8[l]) for l in range(L))
+                k_scale = tuple(k_scale[l].at[slots].set(ks[l]) for l in range(L))
+                v_scale = tuple(v_scale[l].at[slots].set(vs[l]) for l in range(L))
+            else:
+                k_cache = tuple(k_cache[l].at[slots, :, :, :bucket].set(k8[l])
+                                for l in range(L))
+                v_cache = tuple(v_cache[l].at[slots, :, :, :bucket].set(v8[l])
+                                for l in range(L))
+                k_scale = tuple(k_scale[l].at[slots, :, :bucket].set(ks[l])
+                                for l in range(L))
+                v_scale = tuple(v_scale[l].at[slots, :, :bucket].set(vs[l])
+                                for l in range(L))
+            first, rng = sample_tokens(last, rng, new_temps, top_k=top_k)
+            tokens = tokens.at[slots].set(first)
+            positions = positions.at[slots].set(lengths)
+            temps = temps.at[slots].set(new_temps)
+            k_cache = tuple(_pin_standard_layout(k) for k in k_cache)
+            v_cache = tuple(_pin_standard_layout(v) for v in v_cache)
+            return (k_cache, v_cache, k_scale, v_scale, tokens, positions,
+                    temps, rng, first)
+
+        return prefill
+
     def _prefill_program(self, bucket: int, K: int):
         jnp = self._jnp
+        if self._q8:
+            args = (self.params, self.k_cache, self.v_cache, self.k_scale,
+                    self.v_scale,
+                    jnp.zeros((K, bucket), dtype=jnp.int32),
+                    jnp.zeros((K,), dtype=jnp.int32),
+                    jnp.ones((K,), dtype=jnp.int32),
+                    self._tokens, self._positions, self._temps,
+                    jnp.zeros((K,), dtype=jnp.float32), self.rng)
+            return self.executor.compile(
+                f"llama-prefill-q8-{bucket}x{K}-S{self._cache_len}"
+                f"{self._attn_suffix}",
+                self._prefill_fn_q8(bucket, K),
+                args, donate_argnums=(1, 2, 3, 4, 8, 9, 10))
         args = (self.params, self.k_cache, self.v_cache,
                 jnp.zeros((K, bucket), dtype=jnp.int32),
                 jnp.zeros((K,), dtype=jnp.int32),
@@ -756,8 +872,44 @@ class LLMEngine:
         outstanding = len(self._inflight) + 1
         return longest + self.decode_block_size * outstanding + 1
 
+    def _decode_fn_q8(self, block: int):
+        """MIRRORS _decode_fn with scale buffers in the scan carry; keep
+        the two in sync (see _prefill_fn_q8 note)."""
+        cfg = self.cfg
+        top_k = self.top_k
+        import jax
+
+        def decode(params, k_cache, v_cache, k_scale, v_scale, tokens,
+                   positions, temps, rng):
+            def step(carry, _):
+                k, v, ks, vs, tok, pos, rng = carry
+                logits, k, v, ks, vs = llama_decode_step_unrolled_q8(
+                    params, cfg, tok, pos, k, v, ks, vs)
+                nxt, rng = sample_tokens(logits, rng, temps, top_k=top_k)
+                return (k, v, ks, vs, nxt, pos + 1, rng), nxt
+
+            k_cache = tuple(_pin_standard_layout(k) for k in k_cache)
+            v_cache = tuple(_pin_standard_layout(v) for v in v_cache)
+            (k_cache, v_cache, k_scale, v_scale, tok, pos, rng), out = \
+                jax.lax.scan(step, (k_cache, v_cache, k_scale, v_scale,
+                                    tokens, positions, rng), None,
+                             length=block)
+            k_cache = tuple(_pin_standard_layout(k) for k in k_cache)
+            v_cache = tuple(_pin_standard_layout(v) for v in v_cache)
+            return (k_cache, v_cache, k_scale, v_scale, tok, pos, rng,
+                    out.T)
+
+        return decode
+
     def _decode_program(self, block: Optional[int] = None):
         block = block or self.decode_block_size
+        if self._q8:
+            args = (self.params, self.k_cache, self.v_cache, self.k_scale,
+                    self.v_scale, self._tokens, self._positions, self._temps,
+                    self.rng)
+            name = f"llama-decode-q8-x{block}-S{self._cache_len}"
+            return self.executor.compile(name, self._decode_fn_q8(block),
+                                         args, donate_argnums=(1, 2, 3, 4))
         args = (self.params, self.k_cache, self.v_cache,
                 self._tokens, self._positions, self._temps, self.rng)
         suffix = "-kern" if self.cfg.decode_attn == "kernel" else ""
@@ -968,12 +1120,23 @@ class LLMEngine:
             self._grow_cache(bucket + 1)
         program = self._prefill_program(bucket, K)
         try:
-            (self.k_cache, self.v_cache, self._tokens, self._positions,
-             self._temps, self.rng, first) = program(
-                self.params, self.k_cache, self.v_cache,
-                jnp.asarray(ptokens), jnp.asarray(np.asarray(slots_idx, dtype=np.int32)),
-                jnp.asarray(lengths), self._tokens, self._positions, self._temps,
-                jnp.asarray(new_temps), self.rng)
+            if self._q8:
+                (self.k_cache, self.v_cache, self.k_scale, self.v_scale,
+                 self._tokens, self._positions, self._temps, self.rng,
+                 first) = program(
+                    self.params, self.k_cache, self.v_cache, self.k_scale,
+                    self.v_scale, jnp.asarray(ptokens),
+                    jnp.asarray(np.asarray(slots_idx, dtype=np.int32)),
+                    jnp.asarray(lengths), self._tokens, self._positions,
+                    self._temps, jnp.asarray(new_temps), self.rng)
+            else:
+                (self.k_cache, self.v_cache, self._tokens, self._positions,
+                 self._temps, self.rng, first) = program(
+                    self.params, self.k_cache, self.v_cache,
+                    jnp.asarray(ptokens),
+                    jnp.asarray(np.asarray(slots_idx, dtype=np.int32)),
+                    jnp.asarray(lengths), self._tokens, self._positions,
+                    self._temps, jnp.asarray(new_temps), self.rng)
         except Exception as exc:
             raise CacheLostError(f"prefill dispatch failed: {exc}") from exc
 
@@ -1009,10 +1172,17 @@ class LLMEngine:
                     if slot.active]
         start = time.time()
         try:
-            (self.k_cache, self.v_cache, self._tokens, self._positions,
-             self.rng, out_tokens) = program(
-                self.params, self.k_cache, self.v_cache,
-                self._tokens, self._positions, self._temps, self.rng)
+            if self._q8:
+                (self.k_cache, self.v_cache, self.k_scale, self.v_scale,
+                 self._tokens, self._positions, self.rng, out_tokens) = \
+                    program(self.params, self.k_cache, self.v_cache,
+                            self.k_scale, self.v_scale, self._tokens,
+                            self._positions, self._temps, self.rng)
+            else:
+                (self.k_cache, self.v_cache, self._tokens, self._positions,
+                 self.rng, out_tokens) = program(
+                    self.params, self.k_cache, self.v_cache,
+                    self._tokens, self._positions, self._temps, self.rng)
         except Exception as exc:
             raise CacheLostError(f"decode dispatch failed: {exc}") from exc
         dspan = self._dispatch_span("tpu.decode", next(self._batch_seq),

@@ -130,3 +130,31 @@ def test_plan_summary_is_loggable():
                          prefill_buckets=(128,))
     s = plan.summary()
     assert "slots=8" in s and "fits=True" in s
+
+
+def test_int8_kv_plan_fits_more():
+    """int8 cache (1 byte + f32 scales) plans smaller than bf16 (2 bytes):
+    the same budget admits more slots/sequence."""
+    import dataclasses
+
+    from gofr_tpu.models.llama import LlamaConfig
+    from gofr_tpu.tpu.capacity import plan_capacity
+
+    cfg = LlamaConfig.llama1b()
+    cfg8 = dataclasses.replace(cfg, decode_attn="kernel", kv_dtype="int8")
+    budget = 16 << 30
+    plan_bf16 = plan_capacity(cfg, 256, 2048, budget,
+                              prefill_buckets=(512,))
+    plan_q8 = plan_capacity(cfg8, 256, 2048, budget,
+                            prefill_buckets=(512,))
+    # the same budget admits strictly more token capacity...
+    assert (plan_q8.n_slots * plan_q8.max_seq_len
+            > plan_bf16.n_slots * plan_bf16.max_seq_len)
+    # ...because at equal shapes the int8 cache (1 byte + f32 scales per
+    # dh=64 token vector) costs about half the bf16 cache
+    from gofr_tpu.tpu.capacity import kv_cache_bytes
+
+    bf16_bytes = kv_cache_bytes(cfg, 128, 2048)
+    q8_bytes = (kv_cache_bytes(cfg8, 128, 2048, dtype="int8")
+                + 2 * cfg.n_layers * 128 * cfg.n_kv_heads * 2048 * 4)
+    assert q8_bytes < 0.6 * bf16_bytes

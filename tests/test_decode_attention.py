@@ -91,3 +91,39 @@ def test_live_length_clamp_matches_reference():
     out = decode_attention(q, k, v, lens, block_s=16)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
+
+
+def test_int8_kv_kernel_matches_reference():
+    """int8 caches + per-token scales: kernel (scale-folded dequant) vs the
+    XLA oracle (materialized dequant)."""
+    from gofr_tpu.ops.decode_attention import quantize_kv
+
+    rng = np.random.default_rng(3)
+    B, H, Hkv, dh, S = 3, 8, 2, 16, 64
+    q = jnp.asarray(rng.normal(size=(B, H, dh)), dtype=jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, Hkv, dh, S)), dtype=jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, Hkv, dh, S)), dtype=jnp.float32)
+    k8, ks = quantize_kv(k)
+    v8, vs = quantize_kv(v)
+    assert k8.dtype == jnp.int8 and ks.shape == (B, Hkv, S)
+    lens = jnp.asarray([5, 33, 64], dtype=jnp.int32)
+    ref = decode_attention_reference(q, k8, v8, lens, ks, vs)
+    out = decode_attention(q, k8, v8, lens, ks, vs, block_s=16)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=5e-2, atol=5e-2)
+    # and the quantized read stays close to the full-precision answer
+    exact = decode_attention_reference(q, k, v, lens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(exact),
+                               rtol=0.15, atol=0.15)
+
+
+def test_quantize_kv_roundtrip_error_bounded():
+    from gofr_tpu.ops.decode_attention import quantize_kv
+
+    rng = np.random.default_rng(4)
+    x = jnp.asarray(rng.normal(size=(2, 3, 16, 8)) * 5, dtype=jnp.float32)
+    q8, scale = quantize_kv(x)
+    restored = q8.astype(jnp.float32) * scale[:, :, None, :]
+    err = np.max(np.abs(np.asarray(restored - x)))
+    amax = np.max(np.abs(np.asarray(x)), axis=2)
+    assert err <= np.max(amax) / 127.0 + 1e-6

@@ -1,0 +1,88 @@
+"""INT8 KV-cache serving: quantized engine vs the full-precision engine.
+
+The int8 path quantizes K/V on write (per-token per-head scales) and
+dequantizes inside the Pallas decode kernel's dots; the prefill forward is
+full-precision (temps quantize only at the splice), so the FIRST sampled
+token must match the fp engine exactly. Later tokens may drift where two
+logits are near-ties — asserted as high agreement, plus determinism.
+"""
+
+import dataclasses
+
+import pytest
+
+from gofr_tpu.models.llama import LlamaConfig, llama_init
+from gofr_tpu.tpu.engine import LLMEngine
+
+CFG = LlamaConfig.debug()
+CFG_Q8 = dataclasses.replace(CFG, decode_attn="kernel", kv_dtype="int8")
+
+PROMPTS = [list(range(1, 9)), [7, 5, 3], list(range(20, 50)), [11]]
+
+
+def _serve(cfg, prompts, max_new=12):
+    params = llama_init(CFG, seed=0)
+    eng = LLMEngine(params, cfg, n_slots=4, max_seq_len=128,
+                    prefill_buckets=(8, 32), decode_block_size=4)
+    eng.start()
+    try:
+        reqs = [eng.submit(p, max_new_tokens=max_new, temperature=0.0)
+                for p in prompts]
+        return [r.result(timeout_s=300) for r in reqs]
+    finally:
+        eng.stop()
+
+
+def test_q8_engine_serves_and_matches_fp_closely():
+    fp = _serve(dataclasses.replace(CFG, decode_attn="kernel"), PROMPTS)
+    q8 = _serve(CFG_Q8, PROMPTS)
+    assert [len(t) for t in q8] == [len(t) for t in fp]
+    # prefill is full-precision in both: first sampled token identical
+    for fp_toks, q8_toks in zip(fp, q8):
+        assert fp_toks[0] == q8_toks[0]
+    # decode reads differ only by int8 rounding: near-ties may flip, the
+    # bulk must agree
+    total = sum(len(t) for t in fp)
+    agree = sum(a == b for fp_t, q8_t in zip(fp, q8)
+                for a, b in zip(fp_t, q8_t))
+    assert agree / total > 0.7, f"only {agree}/{total} tokens agree"
+
+
+def test_q8_engine_deterministic():
+    a = _serve(CFG_Q8, PROMPTS)
+    b = _serve(CFG_Q8, PROMPTS)
+    assert a == b
+
+
+def test_q8_engine_grows_cache():
+    """Admission past the boot allocation forces a q8 grow (values AND
+    scales pad together)."""
+    params = llama_init(CFG, seed=0)
+    eng = LLMEngine(params, CFG_Q8, n_slots=2, max_seq_len=128,
+                    prefill_buckets=(8, 64), decode_block_size=4)
+    eng.start()
+    try:
+        small = eng.submit([1, 2, 3], max_new_tokens=4, temperature=0.0)
+        small.result(timeout_s=300)
+        grown = eng.submit(list(range(1, 60)), max_new_tokens=4,
+                           temperature=0.0)
+        out = grown.result(timeout_s=300)
+        assert len(out) == 4
+        assert eng._cache_len >= 64
+        assert eng.k_scale[0].shape[-1] == eng._cache_len
+    finally:
+        eng.stop()
+
+
+def test_q8_requires_kernel_decode():
+    params = llama_init(CFG, seed=0)
+    with pytest.raises(ValueError, match="decode_attn"):
+        LLMEngine(params, dataclasses.replace(CFG, kv_dtype="int8"),
+                  n_slots=2, max_seq_len=64, prefill_buckets=(8,))
+
+
+def test_q8_rejects_chunked_prefill():
+    params = llama_init(CFG, seed=0)
+    with pytest.raises(ValueError, match="chunk"):
+        LLMEngine(params, CFG_Q8, n_slots=2, max_seq_len=64,
+                  prefill_buckets=(8, 32), chunk_prefill_tokens=8)
