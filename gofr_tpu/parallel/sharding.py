@@ -95,6 +95,13 @@ def kv_cache_layer_spec():
     return _P(None, "tp", None, None)
 
 
+def kv_scale_layer_spec():
+    """Per-layer int8 dequant scales [B, Hkv, S]: KV heads over tp,
+    row-aligned with kv_cache_layer_spec so each shard reads exactly its
+    heads' scales."""
+    return _P(None, "tp", None)
+
+
 def batch_spec():
     """Token batches [B, T]: batch over dp, sequence over sp."""
     return _P("dp", "sp")

@@ -277,9 +277,6 @@ class LLMEngine:
             if cfg.decode_attn != "kernel":
                 raise ValueError("kv_dtype='int8' requires decode_attn="
                                  "'kernel' (no efficient XLA dequant read)")
-            if mesh is not None:
-                raise ValueError("kv_dtype='int8' with a tp mesh is not "
-                                 "supported yet (scale sharding specs)")
             if chunk_prefill_tokens:
                 raise ValueError("kv_dtype='int8' with chunked prefill is "
                                  "not supported yet (chunk reads need a "
@@ -357,6 +354,26 @@ class LLMEngine:
         if self.mesh is not None:
             self._place_state()
 
+    def _place_cache(self) -> None:
+        """Commit the cache buffers (and, for int8, their scale buffers) to
+        the mesh: KV heads over tp. Called at init and after every growth
+        re-pad — the two sites MUST place identically or grown caches would
+        serve with a different sharding than fresh ones."""
+        import jax
+        from jax.sharding import NamedSharding
+
+        from ..parallel.sharding import kv_cache_layer_spec, kv_scale_layer_spec
+
+        cache_s = NamedSharding(self.mesh, kv_cache_layer_spec())
+        self.k_cache = tuple(jax.device_put(k, cache_s) for k in self.k_cache)
+        self.v_cache = tuple(jax.device_put(v, cache_s) for v in self.v_cache)
+        if self._q8:
+            scale_s = NamedSharding(self.mesh, kv_scale_layer_spec())
+            self.k_scale = tuple(jax.device_put(s, scale_s)
+                                 for s in self.k_scale)
+            self.v_scale = tuple(jax.device_put(s, scale_s)
+                                 for s in self.v_scale)
+
     def _place_state(self) -> None:
         """Commit device state to the mesh: cache KV-heads over tp, loop
         state replicated. Committed shardings propagate into every compiled
@@ -364,11 +381,7 @@ class LLMEngine:
         import jax
         from jax.sharding import NamedSharding, PartitionSpec
 
-        from ..parallel.sharding import kv_cache_layer_spec
-
-        cache_s = NamedSharding(self.mesh, kv_cache_layer_spec())
-        self.k_cache = tuple(jax.device_put(k, cache_s) for k in self.k_cache)
-        self.v_cache = tuple(jax.device_put(v, cache_s) for v in self.v_cache)
+        self._place_cache()
         rep = NamedSharding(self.mesh, PartitionSpec())
         self._tokens = jax.device_put(self._tokens, rep)
         self._positions = jax.device_put(self._positions, rep)
@@ -421,14 +434,7 @@ class LLMEngine:
             # handler must NOT swallow it
             raise CacheLostError(f"cache growth to {new_len} failed: {exc}") from exc
         if self.mesh is not None:  # re-commit: pad must not drop the sharding
-            import jax
-            from jax.sharding import NamedSharding
-
-            from ..parallel.sharding import kv_cache_layer_spec
-
-            cache_s = NamedSharding(self.mesh, kv_cache_layer_spec())
-            self.k_cache = tuple(jax.device_put(k, cache_s) for k in self.k_cache)
-            self.v_cache = tuple(jax.device_put(v, cache_s) for v in self.v_cache)
+            self._place_cache()
         self._cache_len = new_len
         if self.logger is not None:
             self.logger.debugf("grew KV cache to %d", new_len)

@@ -86,3 +86,36 @@ def test_q8_rejects_chunked_prefill():
     with pytest.raises(ValueError, match="chunk"):
         LLMEngine(params, CFG_Q8, n_slots=2, max_seq_len=64,
                   prefill_buckets=(8, 32), chunk_prefill_tokens=8)
+
+
+def test_q8_engine_tp_mesh_matches_single_device():
+    """int8 KV under a tp mesh: values shard KV heads (kv_cache_layer_spec),
+    scales shard alongside (kv_scale_layer_spec); greedy decode must match
+    the single-device q8 engine token-for-token."""
+    import jax
+
+    from gofr_tpu.parallel import MeshPlan, make_mesh
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device virtual mesh")
+    cfg = dataclasses.replace(
+        LlamaConfig(vocab_size=256, dim=64, n_layers=2, n_heads=8,
+                    n_kv_heads=8, ffn_dim=128, max_seq_len=128,
+                    dtype="float32"),
+        decode_attn="kernel", kv_dtype="int8")
+    mesh = make_mesh(MeshPlan(tp=8))
+    prompts = [[1, 2, 3, 4, 5], [9, 8, 7], [17]]
+
+    def serve(m):
+        params = llama_init(dataclasses.replace(cfg, kv_dtype=None), seed=0)
+        eng = LLMEngine(params, cfg, n_slots=4, max_seq_len=64,
+                        prefill_buckets=(8,), mesh=m)
+        eng.start()
+        try:
+            reqs = [eng.submit(p, max_new_tokens=6, temperature=0.0)
+                    for p in prompts]
+            return [r.result(timeout_s=240) for r in reqs]
+        finally:
+            eng.stop()
+
+    assert serve(mesh) == serve(None)
