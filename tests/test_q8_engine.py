@@ -119,3 +119,35 @@ def test_q8_engine_tp_mesh_matches_single_device():
             eng.stop()
 
     assert serve(mesh) == serve(None)
+
+
+def test_q8_tp_scale_sharding_survives_growth():
+    """k/v_scale shard their KV-head axis over tp and KEEP that sharding
+    through _grow_cache's q8 re-pad (the regression class the old
+    init-time guard existed to prevent)."""
+    import jax
+
+    from gofr_tpu.parallel import MeshPlan, make_mesh
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 devices")
+    cfg = dataclasses.replace(
+        LlamaConfig(vocab_size=128, dim=32, n_layers=2, n_heads=4,
+                    n_kv_heads=2, ffn_dim=64, max_seq_len=128,
+                    dtype="float32"),
+        decode_attn="kernel", kv_dtype="int8")
+    mesh = make_mesh(MeshPlan(tp=2), devices=jax.devices()[:2])
+    params = llama_init(dataclasses.replace(cfg, kv_dtype=None), seed=0)
+    eng = LLMEngine(params, cfg, n_slots=2, max_seq_len=128,
+                    prefill_buckets=(8,), mesh=mesh)
+    ks0 = eng.k_scale[0]
+    assert ks0.sharding.shard_shape(ks0.shape)[1] == 1  # Hkv=2 over tp=2
+    eng._grow_cache(64)
+    assert eng._cache_len == 64
+    for scales in (eng.k_scale, eng.v_scale):
+        for s in scales:
+            assert s.shape[-1] == 64
+            assert s.sharding.shard_shape(s.shape)[1] == 1, \
+                "scale sharding dropped by growth"
+    k0 = eng.k_cache[0]
+    assert k0.sharding.shard_shape(k0.shape)[1] == 1
