@@ -533,6 +533,88 @@ def llama_prefill_chunk(params, cfg: LlamaConfig, tokens, positions,
     return logits, tuple(k_out), tuple(v_out)
 
 
+def llama_prefill_chunk_q8(params, cfg: LlamaConfig, tokens, positions,
+                           k_layers, v_layers, ks_layers, vs_layers, slots,
+                           project_last=None):
+    """One CHUNK of a cached prefill over INT8 per-layer caches.
+
+    MIRRORS llama_prefill_chunk with the quantized storage: gathers the K
+    slots' int8 rows + scales, quantizes THIS chunk's fresh K/V into them
+    (old tokens keep their original quantization — no requantize drift),
+    and runs the chunk's attention over the dequantized gathered rows.
+    Dequant materializes only [K, Hkv, dh, S] per layer — K gathered rows,
+    not the whole B-row cache, so the int8 cache's HBM win is preserved.
+    The read uses the dequant-of-quantized values for this chunk too, so
+    numerics match what later chunks and decode steps will read.
+
+    tokens: [K, C]; positions: [K, C]; k/v_layers: int8 cache tuples;
+    ks/vs_layers: [B, Hkv, S] f32 scale tuples; slots: [K].
+    Returns (logits [K, V] or None, k_layers, v_layers, ks_layers,
+    vs_layers).
+    """
+    from ..ops.decode_attention import quantize_kv
+
+    K, C = tokens.shape
+    H, Hkv, dh, G = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim, cfg.q_per_kv
+    dt = _np_dtype(cfg.dtype)
+    k_out, v_out = list(k_layers), list(v_layers)
+    ks_out, vs_out = list(ks_layers), list(vs_layers)
+    x = params["tok_emb"][tokens]                          # [K, C, D]
+    batch_idx = jnp.arange(K)[:, None]
+    for l in range(cfg.n_layers):
+        layer = jax.tree_util.tree_map(lambda w: w[l], params["layers"])
+        k_rows8 = k_out[l][slots]                          # [K, Hkv, dh, S]
+        v_rows8 = v_out[l][slots]
+        ks_rows = ks_out[l][slots]                         # [K, Hkv, S]
+        vs_rows = vs_out[l][slots]
+
+        normed = rms_norm(x, layer["attn_norm"], cfg.rms_eps)
+        q = (normed @ layer["wq"]).reshape(K, C, H, dh)
+        k = (normed @ layer["wk"]).reshape(K, C, Hkv, dh)
+        v = (normed @ layer["wv"]).reshape(K, C, Hkv, dh)
+        q = rope(q, positions, cfg.rope_theta)
+        k = rope(k, positions, cfg.rope_theta)
+        k8c, ksc = quantize_kv(k, axis=-1)                 # [K,C,Hkv,dh],[K,C,Hkv]
+        v8c, vsc = quantize_kv(v, axis=-1)
+        k_rows8 = k_rows8.at[batch_idx, :, :, positions].set(k8c)
+        v_rows8 = v_rows8.at[batch_idx, :, :, positions].set(v8c)
+        ks_rows = ks_rows.at[batch_idx, :, positions].set(ksc)
+        vs_rows = vs_rows.at[batch_idx, :, positions].set(vsc)
+
+        k_deq = (k_rows8.astype(jnp.float32)
+                 * ks_rows[:, :, None, :]).astype(dt)
+        v_deq = (v_rows8.astype(jnp.float32)
+                 * vs_rows[:, :, None, :]).astype(dt)
+        # GQA masked read over the dequantized rows — the dense branch of
+        # _attention_block, inlined (the write above had to target the
+        # int8 storage, not the float rows that function scatters into)
+        S = k_deq.shape[-1]
+        qg = q.reshape(K, C, Hkv, G, dh)
+        scores = jnp.einsum("bthgd,bhds->bhgts", qg, k_deq,
+                            preferred_element_type=jnp.float32) / math.sqrt(dh)
+        cache_pos = jnp.arange(S)[None, None, :]
+        visible = cache_pos <= positions[:, :, None]
+        scores = jnp.where(visible[:, None, None, :, :], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        attn = jnp.einsum("bhgts,bhds->bthgd", probs.astype(v_deq.dtype),
+                          v_deq,
+                          preferred_element_type=jnp.float32).astype(x.dtype)
+        x = x + attn.reshape(K, C, H * dh) @ layer["wo"]
+        x = x + _ffn_block(x, layer, cfg)
+
+        k_out[l] = k_out[l].at[slots].set(k_rows8)
+        v_out[l] = v_out[l].at[slots].set(v_rows8)
+        ks_out[l] = ks_out[l].at[slots].set(ks_rows)
+        vs_out[l] = vs_out[l].at[slots].set(vs_rows)
+    out_caches = (tuple(k_out), tuple(v_out), tuple(ks_out), tuple(vs_out))
+    if project_last is None:
+        return (None,) + out_caches
+    x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+    last = x[jnp.arange(K), project_last]                  # [K, D]
+    logits = (last @ params["lm_head"]).astype(jnp.float32)
+    return (logits,) + out_caches
+
+
 def llama_decode_step_paged(params, cfg: LlamaConfig, tokens, positions,
                             k_pool, v_pool, table):
     """One decode step against a PAGED KV cache.

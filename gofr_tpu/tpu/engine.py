@@ -277,10 +277,6 @@ class LLMEngine:
             if cfg.decode_attn != "kernel":
                 raise ValueError("kv_dtype='int8' requires decode_attn="
                                  "'kernel' (no efficient XLA dequant read)")
-            if chunk_prefill_tokens:
-                raise ValueError("kv_dtype='int8' with chunked prefill is "
-                                 "not supported yet (chunk reads need a "
-                                 "dequant cached-attention path)")
 
         self.slots = [_Slot() for _ in range(n_slots)]
         self._pending: "queue.Queue[GenerationRequest]" = queue.Queue()
@@ -725,8 +721,65 @@ class LLMEngine:
 
         return run_chunk
 
+    def _chunk_fn_q8(self, chunk: int, K: int, first: bool, final: bool):
+        """MIRRORS _chunk_fn over the int8 cache + scale buffers (see
+        _prefill_fn_q8 note; the chunk forward is llama_prefill_chunk_q8)."""
+        cfg = self.cfg
+        jnp = self._jnp
+        top_k = self.top_k
+
+        def run_chunk(params, k_cache, v_cache, k_scale, v_scale, ctokens,
+                      cpositions, slots, lengths, start, selected, tokens,
+                      positions, temps, new_temps, rng):
+            from ..models.llama import llama_prefill_chunk_q8
+
+            k_cache = tuple(_pin_standard_layout(k) for k in k_cache)
+            v_cache = tuple(_pin_standard_layout(v) for v in v_cache)
+            logits, k_cache, v_cache, k_scale, v_scale = \
+                llama_prefill_chunk_q8(
+                    params, cfg, ctokens, cpositions, k_cache, v_cache,
+                    k_scale, v_scale, slots,
+                    project_last=jnp.clip(lengths - 1 - start, 0, chunk - 1))
+            in_chunk = ((lengths - 1 >= start)
+                        & (lengths - 1 < start + chunk))       # [K]
+            selected = jnp.where(in_chunk[:, None], logits, selected)
+            if first:
+                park = k_cache[0].shape[-1] - 1
+                positions = positions.at[slots].set(park)
+            if final:
+                first_tok, rng = sample_tokens(selected, rng, new_temps,
+                                               top_k=top_k)
+                tokens = tokens.at[slots].set(first_tok)
+                positions = positions.at[slots].set(lengths)
+                temps = temps.at[slots].set(new_temps)
+            else:
+                first_tok = selected[:, 0].astype(jnp.int32)  # unused filler
+            k_cache = tuple(_pin_standard_layout(k) for k in k_cache)
+            v_cache = tuple(_pin_standard_layout(v) for v in v_cache)
+            return (k_cache, v_cache, k_scale, v_scale, selected, tokens,
+                    positions, temps, rng, first_tok)
+
+        return run_chunk
+
     def _chunk_program(self, chunk: int, K: int, first: bool, final: bool):
         jnp = self._jnp
+        tag = (f"{'-first' if first else ''}{'-final' if final else ''}"
+               f"-S{self._cache_len}")
+        if self._q8:
+            args = (self.params, self.k_cache, self.v_cache, self.k_scale,
+                    self.v_scale,
+                    jnp.zeros((K, chunk), dtype=jnp.int32),
+                    jnp.zeros((K, chunk), dtype=jnp.int32),
+                    jnp.zeros((K,), dtype=jnp.int32),
+                    jnp.ones((K,), dtype=jnp.int32),
+                    jnp.zeros((), dtype=jnp.int32),
+                    jnp.zeros((K, self.cfg.vocab_size), dtype=jnp.float32),
+                    self._tokens, self._positions, self._temps,
+                    jnp.zeros((K,), dtype=jnp.float32), self.rng)
+            return self.executor.compile(
+                f"llama-chunk-q8-{chunk}x{K}{tag}",
+                self._chunk_fn_q8(chunk, K, first, final), args,
+                donate_argnums=(1, 2, 3, 4, 10, 11, 12, 13))
         args = (self.params, self.k_cache, self.v_cache,
                 jnp.zeros((K, chunk), dtype=jnp.int32),
                 jnp.zeros((K, chunk), dtype=jnp.int32),
@@ -736,11 +789,9 @@ class LLMEngine:
                 jnp.zeros((K, self.cfg.vocab_size), dtype=jnp.float32),
                 self._tokens, self._positions, self._temps,
                 jnp.zeros((K,), dtype=jnp.float32), self.rng)
-        name = (f"llama-chunk-{chunk}x{K}"
-                f"{'-first' if first else ''}{'-final' if final else ''}"
-                f"-S{self._cache_len}")
         return self.executor.compile(
-            name, self._chunk_fn(chunk, K, first, final), args,
+            f"llama-chunk-{chunk}x{K}{tag}",
+            self._chunk_fn(chunk, K, first, final), args,
             donate_argnums=(1, 2, 8, 9, 10, 11))
 
     def _start_chunk_job(self, bucket: int, slots_idx: List[int],
@@ -805,15 +856,28 @@ class LLMEngine:
         program = self._chunk_program(chunk, K, first=(start == 0),
                                       final=final)
         try:
-            (self.k_cache, self.v_cache, job["selected"], self._tokens,
-             self._positions, self._temps, self.rng, first_tok) = program(
-                self.params, self.k_cache, self.v_cache,
-                jnp.asarray(ctokens), jnp.asarray(cpositions),
-                jnp.asarray(np.asarray(job["slots_idx"], dtype=np.int32)),
-                jnp.asarray(job["lengths"]),
-                jnp.asarray(start, dtype=jnp.int32), job["selected"],
-                self._tokens, self._positions, self._temps,
-                jnp.asarray(job["new_temps"]), self.rng)
+            if self._q8:
+                (self.k_cache, self.v_cache, self.k_scale, self.v_scale,
+                 job["selected"], self._tokens, self._positions, self._temps,
+                 self.rng, first_tok) = program(
+                    self.params, self.k_cache, self.v_cache, self.k_scale,
+                    self.v_scale, jnp.asarray(ctokens),
+                    jnp.asarray(cpositions),
+                    jnp.asarray(np.asarray(job["slots_idx"], dtype=np.int32)),
+                    jnp.asarray(job["lengths"]),
+                    jnp.asarray(start, dtype=jnp.int32), job["selected"],
+                    self._tokens, self._positions, self._temps,
+                    jnp.asarray(job["new_temps"]), self.rng)
+            else:
+                (self.k_cache, self.v_cache, job["selected"], self._tokens,
+                 self._positions, self._temps, self.rng, first_tok) = program(
+                    self.params, self.k_cache, self.v_cache,
+                    jnp.asarray(ctokens), jnp.asarray(cpositions),
+                    jnp.asarray(np.asarray(job["slots_idx"], dtype=np.int32)),
+                    jnp.asarray(job["lengths"]),
+                    jnp.asarray(start, dtype=jnp.int32), job["selected"],
+                    self._tokens, self._positions, self._temps,
+                    jnp.asarray(job["new_temps"]), self.rng)
         except Exception as exc:
             raise CacheLostError(f"chunk prefill dispatch failed: {exc}") from exc
         job["next_start"] = start + chunk
