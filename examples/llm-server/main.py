@@ -94,6 +94,10 @@ def build_engine(app: App) -> LLMEngine:
         # blocks interleave (TTFT under mixed traffic); must divide the
         # buckets it applies to
         chunk_prefill_tokens=app.config.get_int("CHUNK_PREFILL_TOKENS", 0),
+        # >0 enables prompt-lookup speculative decoding: up to N draft
+        # tokens verified per dispatch; greedy output is identical, wins
+        # come on self-repetitive text (RAG, code edits, summaries)
+        speculative_tokens=app.config.get_int("SPECULATIVE_TOKENS", 0),
     )
     engine.tokenizer = tokenizer
     engine.start()

@@ -533,6 +533,67 @@ def llama_prefill_chunk(params, cfg: LlamaConfig, tokens, positions,
     return logits, tuple(k_out), tuple(v_out)
 
 
+def llama_verify_step(params, cfg: LlamaConfig, tokens, drafts, positions,
+                      k_layers, v_layers):
+    """Speculative-decode VERIFY: score the current token plus d drafted
+    tokens for every slot in ONE forward.
+
+    tokens: [B] each slot's current (already-sampled) token; drafts: [B, d]
+    proposed continuations (junk rows allowed — acceptance is decided by
+    the caller); positions: [B] the current token's absolute position;
+    k/v_layers: per-layer serving caches.
+
+    Window = [tokens | drafts] at positions [pos .. pos+d]. The forward
+    writes the window's K/V into the cache — for the accepted prefix these
+    ARE the tokens decode would have written (a draft is only accepted when
+    it equals the model's own greedy choice), and rejected positions hold
+    junk that is overwritten by their eventual real occupant before any
+    query attends them (the engine's standard lock-step junk-write
+    invariant).
+
+    Returns (greedy [B, d+1] int32 — argmax continuation after each window
+    position, logits0 [B, V] float32 — position-0 logits for temperature
+    sampling, k_layers, v_layers).
+
+    The lm_head projects one window position at a time ([B, D] @ [D, V],
+    then argmax) so no [B, d+1, V] logits buffer ever materializes — at
+    Llama-3 vocab that buffer would be ~0.5 GB per dispatch.
+
+    NOTE: the window's cached attention is the dense masked einsum (a
+    T=d+1 read never hits the T==1 decode kernel branch), so each verify
+    dispatch reads the full allocated cache per layer regardless of
+    cfg.decode_attn — speculation trades the kernel's live-length
+    streaming read for multi-token verification. Favorable when acceptance
+    is high or contexts are short; long-context random text prefers plain
+    kernel-mode block decode.
+    """
+    B, d = drafts.shape
+    window = jnp.concatenate([tokens[:, None], drafts], axis=1)  # [B, d+1]
+    pos_grid = positions[:, None] + jnp.arange(d + 1, dtype=jnp.int32)[None, :]
+
+    x = params["tok_emb"][window]
+    k_out, v_out = [], []
+    for l in range(cfg.n_layers):
+        layer = jax.tree_util.tree_map(lambda w: w[l], params["layers"])
+        attn, k_l, v_l = _attention_block(x, layer, k_layers[l], v_layers[l],
+                                          pos_grid, cfg)
+        x = x + attn
+        x = x + _ffn_block(x, layer, cfg)
+        k_out.append(k_l)
+        v_out.append(v_l)
+    x = rms_norm(x, params["final_norm"], cfg.rms_eps)       # [B, d+1, D]
+
+    greedy_cols = []
+    logits0 = None
+    for i in range(d + 1):
+        logits_i = (x[:, i] @ params["lm_head"]).astype(jnp.float32)
+        if i == 0:
+            logits0 = logits_i
+        greedy_cols.append(jnp.argmax(logits_i, axis=-1).astype(jnp.int32))
+    greedy = jnp.stack(greedy_cols, axis=1)                  # [B, d+1]
+    return greedy, logits0, tuple(k_out), tuple(v_out)
+
+
 def llama_prefill_chunk_q8(params, cfg: LlamaConfig, tokens, positions,
                            k_layers, v_layers, ks_layers, vs_layers, slots,
                            project_last=None):

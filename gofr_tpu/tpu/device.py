@@ -85,6 +85,8 @@ class TPUClient:
             ("app_tpu_execute_total", "device executions dispatched"),
             ("app_tpu_tokens_generated_total", "output tokens generated"),
             ("app_tpu_requests_total", "inference requests admitted"),
+            ("app_tpu_spec_drafted_total", "speculative draft tokens proposed"),
+            ("app_tpu_spec_accepted_total", "speculative draft tokens accepted"),
         ):
             try:
                 m.new_counter(name, desc)

@@ -113,7 +113,8 @@ class GenerationRequest:
 
 
 class _Slot:
-    __slots__ = ("request", "length", "remaining", "pages", "chunking")
+    __slots__ = ("request", "length", "remaining", "pages", "chunking",
+                 "history")
 
     def __init__(self):
         self.request: Optional[GenerationRequest] = None
@@ -124,6 +125,9 @@ class _Slot:
         # is being filled chunk by chunk) but not yet emitting — excluded
         # from the free list and from decode demux until the final chunk
         self.chunking: Optional[GenerationRequest] = None
+        # speculative mode only: prompt + emitted tokens, the corpus the
+        # prompt-lookup draft proposal searches
+        self.history: Optional[List[int]] = None
 
     @property
     def active(self) -> bool:
@@ -195,6 +199,7 @@ class LLMEngine:
         budget_bytes: Optional[int] = None,
         tracer=None,
         chunk_prefill_tokens: int = 0,
+        speculative_tokens: int = 0,
     ):
         """mesh: optional jax.sharding.Mesh with a "tp" axis. When given, the
         engine serves TENSOR-PARALLEL: params shard per serving_param_specs
@@ -277,6 +282,25 @@ class LLMEngine:
             if cfg.decode_attn != "kernel":
                 raise ValueError("kv_dtype='int8' requires decode_attn="
                                  "'kernel' (no efficient XLA dequant read)")
+
+        # speculative decoding (prompt-lookup drafting): d > 0 replaces the
+        # block-decode dispatch with a VERIFY dispatch scoring each slot's
+        # current token + up to d host-proposed draft tokens in one forward.
+        # Greedy output is IDENTICAL to plain decode (a draft is accepted
+        # only when it equals the model's own choice); wins come from
+        # emitting accepted+1 tokens per weight-read on structured text.
+        # Verify dispatches cannot be pipelined blind (the next window's
+        # start depends on this one's acceptance), so spec mode runs one
+        # dispatch at a time.
+        self.speculative_tokens = max(0, int(speculative_tokens))
+        if self.speculative_tokens:
+            if self._q8:
+                raise ValueError("speculative_tokens with kv_dtype='int8' "
+                                 "is not supported yet (the verify window "
+                                 "needs a dequant cached-attention read)")
+            if chunk_prefill_tokens:
+                raise ValueError("speculative_tokens with chunked prefill "
+                                 "is not supported yet")
 
         self.slots = [_Slot() for _ in range(n_slots)]
         self._pending: "queue.Queue[GenerationRequest]" = queue.Queue()
@@ -530,9 +554,12 @@ class LLMEngine:
                 self._chunk_program(chunk, 1, first=False, final=True)
                 if any(b > 2 * chunk for b in self.prefill_buckets):
                     self._chunk_program(chunk, 1, first=False, final=False)
-            self._decode_program()
-            if self.decode_block_size > 1:  # the adaptive short-block variant
-                self._decode_program(max(1, self.decode_block_size // 2))
+            if self.speculative_tokens:
+                self._verify_program()
+            else:
+                self._decode_program()
+                if self.decode_block_size > 1:  # adaptive short-block variant
+                    self._decode_program(max(1, self.decode_block_size // 2))
 
     # -- compiled programs ----------------------------------------------------
     def _prefill_fn(self, bucket: int, K: int):
@@ -940,7 +967,115 @@ class LLMEngine:
         longest = max((slot.length for slot in self.slots if slot.active),
                       default=0)
         outstanding = len(self._inflight) + 1
-        return longest + self.decode_block_size * outstanding + 1
+        per_dispatch = (self.speculative_tokens + 1
+                        if self.speculative_tokens else self.decode_block_size)
+        return longest + per_dispatch * outstanding + 1
+
+    # -- speculative decoding (prompt-lookup drafting) ------------------------
+    def _propose_draft(self, history: List[int]) -> List[int]:
+        """Prompt-lookup draft: find the most recent earlier occurrence of
+        the sequence's last bigram and propose the tokens that followed it.
+        O(len(history)) host work per slot per dispatch — negligible next to
+        a device dispatch. Empty when the sequence has no self-match (the
+        verify then degrades to an ordinary one-token step for that slot)."""
+        d = self.speculative_tokens
+        n = 2
+        if len(history) < n + 1:
+            return []
+        tail = history[-n:]
+        for i in range(len(history) - n - 1, -1, -1):
+            if history[i:i + n] == tail:
+                return history[i + n: i + n + d]
+        return []
+
+    def _verify_fn(self, d: int):
+        cfg = self.cfg
+        jnp = self._jnp
+        top_k = self.top_k
+
+        def verify(params, k_cache, v_cache, tokens, positions, temps, rng,
+                   drafts, draft_lens):
+            """Score current+drafts, accept the device-computed greedy
+            prefix, and advance all loop state on device. Returns
+            (k, v, tokens, positions, rng, out_tokens [B, d+1], n_emit [B]):
+            row b emits out_tokens[b, :n_emit[b]]."""
+            from ..models.llama import llama_verify_step
+
+            B = tokens.shape[0]
+            k_cache = tuple(_pin_standard_layout(k) for k in k_cache)
+            v_cache = tuple(_pin_standard_layout(v) for v in v_cache)
+            g, logits0, k_cache, v_cache = llama_verify_step(
+                params, cfg, tokens, drafts, positions, k_cache, v_cache)
+            next0, rng = sample_tokens(logits0, rng, temps, top_k=top_k)
+            greedy_row = temps <= 0.0                      # sampling.py rule
+            matches = ((drafts == g[:, :d])
+                       & (jnp.arange(d, dtype=jnp.int32)[None, :]
+                          < draft_lens[:, None])
+                       & greedy_row[:, None])
+            prefix = jnp.cumprod(matches.astype(jnp.int32), axis=1)
+            accepted = jnp.sum(prefix, axis=1)             # [B]
+            out = g.at[:, 0].set(next0)                    # sampled pos-0
+            tokens = out[jnp.arange(B), accepted]
+            positions = positions + accepted + 1
+            k_cache = tuple(_pin_standard_layout(k) for k in k_cache)
+            v_cache = tuple(_pin_standard_layout(v) for v in v_cache)
+            return (k_cache, v_cache, tokens, positions, rng, out,
+                    accepted + 1)
+
+        return verify
+
+    def _verify_program(self):
+        jnp = self._jnp
+        d = self.speculative_tokens
+        args = (self.params, self.k_cache, self.v_cache,
+                self._tokens, self._positions, self._temps, self.rng,
+                jnp.zeros((self.n_slots, d), dtype=jnp.int32),
+                jnp.zeros((self.n_slots,), dtype=jnp.int32))
+        name = f"llama-verify-x{d}-S{self._cache_len}"
+        return self.executor.compile(name, self._verify_fn(d), args,
+                                     donate_argnums=(1, 2))
+
+    def _dispatch_verify(self) -> None:
+        import numpy as np
+
+        jnp = self._jnp
+        d = self.speculative_tokens
+        need = self._decode_need()
+        if need > self._cache_len:
+            self._grow_cache(need)
+        drafts = np.zeros((self.n_slots, d), dtype=np.int32)
+        lens = np.zeros((self.n_slots,), dtype=np.int32)
+        snapshot = []
+        for i, slot in enumerate(self.slots):
+            if not slot.active:
+                continue
+            snapshot.append((i, slot.request))
+            # greedy rows only (acceptance is exact-match against argmax);
+            # a temperature row rides the dispatch as a plain 1-token step
+            if (slot.request.temperature <= 0.0 and slot.history
+                    and slot.remaining > 0):
+                cont = self._propose_draft(slot.history)
+                if cont:
+                    drafts[i, :len(cont)] = cont
+                    lens[i] = len(cont)
+        program = self._verify_program()
+        start = time.time()
+        try:
+            (self.k_cache, self.v_cache, self._tokens, self._positions,
+             self.rng, out_tokens, n_emit) = program(
+                self.params, self.k_cache, self.v_cache,
+                self._tokens, self._positions, self._temps, self.rng,
+                jnp.asarray(drafts), jnp.asarray(lens))
+        except Exception as exc:
+            raise CacheLostError(f"verify dispatch failed: {exc}") from exc
+        self._obs.counter("app_tpu_spec_drafted_total", float(lens.sum()))
+        dspan = self._dispatch_span("tpu.verify", next(self._batch_seq),
+                                    **{"batch.size": len(snapshot),
+                                       "tpu.draft_tokens": int(lens.sum())})
+        # same arity/dspan position as decode entries: _reset_device_state
+        # closes dspans by fixed index for non-prefill entries
+        self._inflight.append(("verify", (out_tokens, n_emit), snapshot,
+                               d, start, dspan))
 
     def _decode_fn_q8(self, block: int):
         """MIRRORS _decode_fn with scale buffers in the scan carry; keep
@@ -998,8 +1133,16 @@ class LLMEngine:
                     # long prompt's remaining chunks
                     self._advance_chunk_job()
                     any_active = any(slot.active for slot in self.slots)
-                    while any_active and len(self._inflight) < self.pipeline_depth:
-                        self._dispatch_decode()
+                    if self.speculative_tokens:
+                        # one verify at a time: the next window's start
+                        # position depends on this one's acceptance
+                        if any_active and not any(e[0] == "verify"
+                                                  for e in self._inflight):
+                            self._dispatch_verify()
+                    else:
+                        while (any_active
+                               and len(self._inflight) < self.pipeline_depth):
+                            self._dispatch_decode()
                 if self._inflight:
                     self._sync_oldest()
                 elif not self._chunk_jobs:
@@ -1284,10 +1427,59 @@ class LLMEngine:
                 request.first_token_at = now
                 self._obs.hist("app_tpu_ttft_seconds", now - request.enqueued_at)
                 token = int(first_host[row])
+                if self.speculative_tokens:
+                    slot.history = list(request.prompt_tokens) + [token]
                 self._emit(request, token)
                 if (token in request.stop_tokens or slot.remaining <= 0
                         or request.cancelled.is_set()):
                     self._finish_slot(slot)
+            return
+
+        if entry[0] == "verify":
+            _, fut, snapshot, d, started, dspan = entry
+            out_dev, n_emit_dev = fut
+            try:
+                out_host = np.asarray(out_dev)             # [B, d+1]
+                n_emit_host = np.asarray(n_emit_dev)       # [B]
+            except Exception as exc:
+                if dspan is not None:
+                    dspan.set_status(False, str(exc))
+                    dspan.end()
+                raise CacheLostError(f"verify execution failed: {exc}") from exc
+            if dspan is not None:
+                dspan.end()
+            elapsed = time.time() - started
+            self._obs.hist("app_tpu_execute_seconds", elapsed)
+            emitted = n_active = 0
+            for slot_idx, request in snapshot:
+                slot = self.slots[slot_idx]
+                if slot.request is not request:
+                    continue
+                n_active += 1
+                n = int(n_emit_host[slot_idx])
+                self._obs.counter("app_tpu_spec_accepted_total",
+                                  float(max(0, n - 1)))
+                for t in range(n):
+                    token = int(out_host[slot_idx, t])
+                    slot.length += 1
+                    slot.remaining -= 1
+                    if slot.history is not None:
+                        slot.history.append(token)
+                    self._emit(request, token)
+                    emitted += 1
+                    if (token in request.stop_tokens or slot.remaining <= 0
+                            or request.cancelled.is_set()
+                            or slot.length >= self.max_seq_len - 1):
+                        self._finish_slot(slot)
+                        break
+            # every token in this sync shares one dispatch wall time; the
+            # per-token cost is elapsed / (avg tokens per active slot)
+            if emitted:
+                per_slot = emitted / max(1, n_active)
+                self._obs.hist_n("app_tpu_tpot_seconds", elapsed / per_slot,
+                                 emitted)
+            self._obs.hist("app_tpu_batch_size", n_active)
+            self._track_throughput(emitted)
             return
 
         _, out_tokens, snapshot, block, started, dspan = entry
@@ -1351,6 +1543,7 @@ class LLMEngine:
         slot.request = None
         slot.length = 0
         slot.remaining = 0
+        slot.history = None
         if request is not None:
             request.finished_at = time.time()
             if request.gen_span is not None:

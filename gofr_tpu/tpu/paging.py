@@ -101,6 +101,9 @@ class PagedLLMEngine(LLMEngine):
         if getattr(cfg, "kv_dtype", None) == "int8":
             raise ValueError("kv_dtype='int8' is not supported by the paged "
                              "engine yet (dense LLMEngine only)")
+        if kw.get("speculative_tokens"):
+            raise ValueError("speculative decoding is not supported by the "
+                             "paged engine yet (dense LLMEngine only)")
         self.page_size = page_size
         self._requested_pages = n_pages
         # set pre-super: _init_device_state runs inside super().__init__
