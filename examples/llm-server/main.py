@@ -101,6 +101,10 @@ def build_engine(app: App) -> LLMEngine:
     )
     engine.tokenizer = tokenizer
     engine.start()
+    # graceful drain: finish active generations (bounded) before the HTTP
+    # server goes away; queued requests fail fast so clients can retry
+    app.on_shutdown(lambda: (engine.drain(
+        app.config.get_float("DRAIN_TIMEOUT", 30.0)), engine.stop()))
     if app.config.get_bool("WARMUP", True):
         t0 = time.time()
         engine.warmup()

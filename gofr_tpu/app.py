@@ -103,6 +103,7 @@ class App:
         self._static_dirs: Dict[str, str] = {}
         self._openapi_path = "./static/openapi.json"
         self._started = False
+        self._shutdown_hooks: list = []
 
         # default chain: Tracer -> Logging -> CORS -> Metrics (http/router.go:21-33)
         self.router.use_middleware(
@@ -407,7 +408,19 @@ class App:
         except KeyboardInterrupt:
             self.shutdown()
 
+    def on_shutdown(self, fn) -> None:
+        """Register a hook run FIRST (LIFO) during shutdown — before any
+        server stops. The place for graceful drains: an llm-server registers
+        `lambda: engine.drain()` so active generations finish before the
+        transport goes away."""
+        self._shutdown_hooks.append(fn)
+
     def shutdown(self) -> None:
+        for hook in reversed(self._shutdown_hooks):
+            try:
+                hook()
+            except Exception as exc:  # noqa: BLE001 - shutdown must proceed
+                self.logger.errorf("shutdown hook failed: %s", exc)
         self._subscriptions.stop()
         if self._cron is not None:
             self._cron.stop()

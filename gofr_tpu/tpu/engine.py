@@ -310,6 +310,8 @@ class LLMEngine:
         self._deferred: "collections.deque[GenerationRequest]" = collections.deque()
         self._wake = threading.Event()
         self._stop = threading.Event()
+        # drain(): reject new work, let active generations finish
+        self._draining = False
         self._thread: Optional[threading.Thread] = None
         # serializes device-state mutation (cache growth, program dispatch)
         # between the engine loop and boot-time warmup() on the caller thread
@@ -475,6 +477,8 @@ class LLMEngine:
                span=None) -> GenerationRequest:
         if self._stop.is_set():
             raise RuntimeError("engine is stopped")
+        if self._draining:
+            raise RuntimeError("engine draining: not accepting new requests")
         if not prompt_tokens:
             raise ValueError("prompt_tokens must be non-empty")
         limit = self.admission_limit
@@ -506,6 +510,7 @@ class LLMEngine:
         if self._thread is not None:
             return
         self._stop.clear()
+        self._draining = False  # a drained engine may be restarted
         self._thread = threading.Thread(target=self._loop, name="llm-engine", daemon=True)
         self._thread.start()
 
@@ -516,6 +521,31 @@ class LLMEngine:
             self._thread.join(timeout=30)
             self._thread = None
         self._drain_pending(RuntimeError("engine stopped"))
+
+    def drain(self, timeout_s: float = 30.0) -> bool:
+        """Graceful shutdown, phase 1: stop admitting, fail queued requests
+        fast (their clients should retry elsewhere), and let ACTIVE
+        generations run to completion, bounded by timeout_s.
+
+        Returns True when every active request finished; False on timeout
+        (call stop() either way — it fails whatever remains). The serving
+        analog of connection draining on a deregistering backend.
+
+        Only sets the flag and waits: the LOOP thread fails the queued
+        requests (its _admit drains them when _draining is set), so queue
+        and allocator state are mutated by exactly one thread — calling
+        _drain_pending here would race _admit's own pop loop."""
+        self._draining = True
+        self._wake.set()
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            busy = (any(s.active or s.chunking is not None for s in self.slots)
+                    or self._inflight or self._chunk_jobs
+                    or self._deferred or self._pending.qsize())
+            if not busy:
+                return True
+            time.sleep(0.05)
+        return False
 
     def warmup(self, grow: bool = True) -> None:
         """Pre-compile single-admission prefill buckets and the decode
@@ -1177,6 +1207,12 @@ class LLMEngine:
         behind interleaved decode blocks), so unlimited is the default.
         With chunk_prefill_tokens set, buckets larger than the chunk size
         go through the chunk-job path instead of one fused dispatch."""
+        if self._draining:
+            # drain() already failed the queue; anything racing in after
+            # that must not start generating on a server that is going away
+            self._drain_pending(RuntimeError("engine draining: not "
+                                             "accepting new requests"))
+            return
         free = [i for i, slot in enumerate(self.slots)
                 if not slot.active and slot.chunking is None]
         if not free:

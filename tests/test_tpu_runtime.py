@@ -497,3 +497,48 @@ def test_engine_stop_unblocks_active_requests():
     eng.stop()
     with pytest.raises(RuntimeError, match="engine stopped"):
         req.result(timeout_s=30)
+
+
+def test_engine_drain_finishes_active_rejects_new():
+    """drain(): active generations complete with their full token budget,
+    queued/new requests fail fast, stop() afterwards is clean."""
+    from gofr_tpu.models.llama import LlamaConfig, llama_init
+    from gofr_tpu.tpu.engine import LLMEngine
+
+    cfg = LlamaConfig.debug()
+    eng = LLMEngine(llama_init(cfg, seed=0), cfg, n_slots=2, max_seq_len=128,
+                    prefill_buckets=(8,), decode_block_size=4)
+    eng.start()
+    try:
+        active = eng.submit([1, 2, 3], max_new_tokens=24, temperature=0.0)
+        # wait for admission so drain sees an ACTIVE slot, not a queued req
+        deadline = time.time() + 60
+        while active.admitted_at is None and time.time() < deadline:
+            time.sleep(0.01)
+        assert active.admitted_at is not None
+        assert eng.drain(timeout_s=120) is True
+        tokens = active.result(timeout_s=10)
+        assert len(tokens) == 24, "drained request lost tokens"
+        with pytest.raises(RuntimeError, match="draining"):
+            eng.submit([4, 5], max_new_tokens=4)
+        # a drained engine may be restarted: stop/start clears the flag
+        eng.stop()
+        eng.start()
+        again = eng.submit([7, 8, 9], max_new_tokens=3, temperature=0.0)
+        assert len(again.result(timeout_s=120)) == 3
+    finally:
+        eng.stop()
+
+
+def test_app_shutdown_hooks_run_lifo():
+    from gofr_tpu import App
+    from gofr_tpu.config import MockConfig
+
+    app = App(config=MockConfig({"HTTP_PORT": "0", "METRICS_PORT": "0"}))
+    order = []
+    app.on_shutdown(lambda: order.append("first"))
+    app.on_shutdown(lambda: order.append("second"))
+    app.on_shutdown(lambda: 1 / 0)  # a failing hook must not block the rest
+    app.start()
+    app.shutdown()
+    assert order == ["second", "first"]
