@@ -1,0 +1,206 @@
+"""OpenAI-compatible serving surface over the continuous-batching engine.
+
+Drop-in endpoints for clients speaking the OpenAI REST shapes:
+
+  GET  /v1/models                      -> model listing
+  POST /v1/completions                 -> text completion (+SSE streaming)
+  POST /v1/chat/completions            -> chat completion (+SSE streaming)
+
+Streaming responses emit `data: {json}` SSE chunks and terminate with
+`data: [DONE]`, matching the OpenAI wire contract, so existing SDKs can
+point their base_url here. The engine underneath is the same LLMEngine
+the native /generate endpoint uses (examples/llm-server), with every
+framework feature available (kernel decode, int8 KV, speculation, drain).
+"""
+
+import json
+import os
+import sys
+import time
+import uuid
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from gofr_tpu import App, Stream  # noqa: E402
+from gofr_tpu.http.errors import InvalidParam  # noqa: E402
+from gofr_tpu.http.responder import Raw  # noqa: E402
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", "llm-server"))
+from main import build_engine  # noqa: E402
+
+
+def _render_chat(messages) -> str:
+    """Minimal chat template: role-tagged turns + assistant cue. A real
+    deployment swaps this for the model family's template."""
+    lines = [f"{m.get('role', 'user')}: {m.get('content', '')}"
+             for m in messages]
+    lines.append("assistant:")
+    return "\n".join(lines)
+
+
+def build_app(**kw) -> App:
+    app = App(**kw)
+    engine = build_engine(app)
+    tokenizer = engine.tokenizer
+    model_id = app.config.get_or_default("MODEL_PRESET", "debug")
+
+    def _params(body: dict):
+        """Parse/validate the shared generation params once (a bad type is
+        a 400 parameter error, not a 500)."""
+        try:
+            max_tokens = int(body.get("max_tokens", 16))
+            temperature = float(body.get("temperature", 1.0))
+        except (TypeError, ValueError) as exc:
+            raise InvalidParam(["max_tokens", "temperature"]) from exc
+        if max_tokens < 1:
+            raise InvalidParam(["max_tokens"])
+        stop = body.get("stop") or []
+        if isinstance(stop, str):
+            stop = [stop]
+        if not all(isinstance(s, str) for s in stop):
+            raise InvalidParam(["stop"])
+        return max_tokens, temperature, stop
+
+    def _submit(prompt: str, max_tokens: int, temperature: float):
+        prompt_tokens = tokenizer.encode(prompt)
+        if len(prompt_tokens) > engine.admission_limit:
+            # the OpenAI contract: context_length_exceeded is a 400, never
+            # a silent truncation that would drop system prompts unnoticed
+            raise InvalidParam(
+                [f"prompt: {len(prompt_tokens)} tokens exceeds the model "
+                 f"context limit ({engine.admission_limit})"])
+        request = engine.submit(prompt_tokens, max_new_tokens=max_tokens,
+                                temperature=temperature,
+                                stop_tokens={tokenizer.EOS})
+        return request, prompt_tokens
+
+    def _finish_reason(n_emitted: int, max_tokens: int) -> str:
+        return "length" if n_emitted >= max_tokens else "stop"
+
+    @app.get("/v1/models")
+    def models(ctx):
+        return Raw({"object": "list",
+                    "data": [{"id": model_id, "object": "model",
+                              "owned_by": "gofr_tpu"}]})
+
+    def _completion(ctx, chat: bool):
+        body = ctx.bind()
+        if not isinstance(body, dict):
+            raise InvalidParam(["body"])
+        if chat:
+            messages = body.get("messages")
+            if not isinstance(messages, list) or not messages:
+                raise InvalidParam(["messages"])
+            prompt = _render_chat(messages)
+        else:
+            prompt = body.get("prompt")
+            if not isinstance(prompt, str) or not prompt:
+                raise InvalidParam(["prompt"])
+        max_tokens, temperature, stop_strs = _params(body)
+        request, prompt_toks = _submit(prompt, max_tokens, temperature)
+        created = int(time.time())
+        rid = (f"chatcmpl-{uuid.uuid4().hex[:24]}" if chat
+               else f"cmpl-{uuid.uuid4().hex[:24]}")
+        obj = "chat.completion" if chat else "text_completion"
+        chunk_obj = "chat.completion.chunk" if chat else "text_completion"
+
+        def _chunk(text=None, finish=None, role=None):
+            if chat:
+                delta = {}
+                if role:
+                    delta["role"] = role
+                if text:
+                    delta["content"] = text
+                choice = {"index": 0, "delta": delta, "finish_reason": finish}
+            else:
+                choice = {"index": 0, "text": text or "",
+                          "finish_reason": finish}
+            return {"id": rid, "object": chunk_obj, "created": created,
+                    "model": model_id, "choices": [choice]}
+
+        if body.get("stream"):
+            def chunks():
+                from gofr_tpu.models.tokenizer import StreamingDecoder
+
+                decoder = StreamingDecoder(tokenizer)
+                count = 0
+                if chat:  # role announcement chunk, per the chat protocol
+                    yield _chunk(role="assistant")
+                # stop strings can split across token boundaries: hold back
+                # the last len(longest_stop)-1 chars until more text lands
+                hold = max((len(s) for s in stop_strs), default=0) - 1
+                acc, sent, stopped = "", 0, False
+                for token in request.stream():
+                    count += 1
+                    acc += decoder.push(token)
+                    cut = min((idx for idx in (acc.find(s, max(0, sent - hold))
+                                               for s in stop_strs)
+                               if idx >= 0), default=-1)
+                    if cut >= 0:
+                        if cut > sent:
+                            yield _chunk(text=acc[sent:cut])
+                        request.cancel()
+                        stopped = True
+                        break
+                    safe = len(acc) - max(hold, 0)
+                    if safe > sent:
+                        yield _chunk(text=acc[sent:safe])
+                        sent = safe
+                if not stopped:
+                    acc += decoder.flush()
+                    cut = min((idx for idx in (acc.find(s, max(0, sent - hold))
+                                               for s in stop_strs)
+                               if idx >= 0), default=-1)
+                    end = cut if cut >= 0 else len(acc)
+                    stopped = cut >= 0
+                    if end > sent:
+                        yield _chunk(text=acc[sent:end])
+                finish = "stop" if stopped else _finish_reason(count, max_tokens)
+                yield _chunk(finish=finish)
+                yield "[DONE]"
+
+            return Stream(chunks(), sse=True, on_close=request.cancel)
+
+        from gofr_tpu.http.errors import RequestTimeout
+
+        try:
+            tokens = request.result(timeout_s=ctx.remaining())
+        except TimeoutError as exc:
+            raise RequestTimeout() from exc
+        text = tokenizer.decode(tokens)
+        finish = _finish_reason(len(tokens), max_tokens)
+        for s in stop_strs:  # string-level stop sequences
+            idx = text.find(s)
+            if idx >= 0:
+                text = text[:idx]
+                finish = "stop"
+        message_or_text = ({"message": {"role": "assistant", "content": text}}
+                           if chat else {"text": text})
+        return Raw({
+            "id": rid, "object": obj, "created": created, "model": model_id,
+            "choices": [dict(index=0, finish_reason=finish,
+                             logprobs=None, **message_or_text)],
+            "usage": {"prompt_tokens": len(prompt_toks),
+                      "completion_tokens": len(tokens),
+                      "total_tokens": len(prompt_toks) + len(tokens)},
+        })
+
+    @app.post("/v1/completions")
+    def completions(ctx):
+        return _completion(ctx, chat=False)
+
+    @app.post("/v1/chat/completions")
+    def chat_completions(ctx):
+        return _completion(ctx, chat=True)
+
+    return app
+
+
+def main() -> None:
+    os.chdir(os.path.dirname(os.path.abspath(__file__)))
+    build_app().run()
+
+
+if __name__ == "__main__":
+    main()

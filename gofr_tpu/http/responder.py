@@ -91,7 +91,12 @@ class Responder:
 
     def respond(self, data: Any, err: Optional[BaseException]) -> Response:
         if err is not None:
-            status = err.status_code if isinstance(err, HTTPError) else 500
+            # duck-typed status_code lets non-HTTP layers (the TPU engine's
+            # draining rejection) map to a proper status without importing
+            # the transport package
+            status = getattr(err, "status_code", None)
+            if not isinstance(status, int):
+                status = err.status_code if isinstance(err, HTTPError) else 500
             payload = {"error": {"message": getattr(err, "message", None) or str(err)}}
             return self._json(status, payload)
 

@@ -58,6 +58,17 @@ class CacheLostError(RuntimeError):
     already be consumed (donation is honored on TPU/GPU), so the engine must
     rebuild device state before serving again."""
 
+
+class EngineDrainingError(RuntimeError):
+    """Submitted while the engine drains for shutdown. status_code is
+    duck-typed for the HTTP responder: 503 tells load balancers and SDK
+    retry policies to go elsewhere (a bare 500 would not be retried)."""
+
+    status_code = 503
+
+    def __init__(self):
+        super().__init__("engine draining: not accepting new requests")
+
 _request_ids = itertools.count(1)
 
 
@@ -478,7 +489,7 @@ class LLMEngine:
         if self._stop.is_set():
             raise RuntimeError("engine is stopped")
         if self._draining:
-            raise RuntimeError("engine draining: not accepting new requests")
+            raise EngineDrainingError()
         if not prompt_tokens:
             raise ValueError("prompt_tokens must be non-empty")
         limit = self.admission_limit
@@ -539,9 +550,15 @@ class LLMEngine:
         self._wake.set()
         deadline = time.time() + timeout_s
         while time.time() < deadline:
-            busy = (any(s.active or s.chunking is not None for s in self.slots)
-                    or self._inflight or self._chunk_jobs
-                    or self._deferred or self._pending.qsize())
+            # under _state_lock: an admission wave mid-flight holds the lock
+            # between popping _pending and binding slots — an unlocked poll
+            # could observe that window as "idle" and green-light stop()
+            # while a just-admitted request is about to bind
+            with self._state_lock:
+                busy = (any(s.active or s.chunking is not None
+                            for s in self.slots)
+                        or self._inflight or self._chunk_jobs
+                        or self._deferred or self._pending.qsize())
             if not busy:
                 return True
             time.sleep(0.05)
@@ -1210,8 +1227,7 @@ class LLMEngine:
         if self._draining:
             # drain() already failed the queue; anything racing in after
             # that must not start generating on a server that is going away
-            self._drain_pending(RuntimeError("engine draining: not "
-                                             "accepting new requests"))
+            self._drain_pending(EngineDrainingError())
             return
         free = [i for i, slot in enumerate(self.slots)
                 if not slot.active and slot.chunking is None]

@@ -244,3 +244,119 @@ def test_using_subscriber(running):
         _time.sleep(0.05)
     assert body["data"]["products"] == {"p1": "10"}
     assert body["data"]["orders"] == {"o1": "sent"}
+
+
+def test_openai_server_example():
+    module = _load("openai-server")
+    app = module.build_app(config=_cfg(TPU_PLATFORM="cpu",
+                                       MODEL_PRESET="debug", WARMUP="false"))
+    app.start()
+    try:
+        port = app.http_port
+        status, body = _call(port, "/v1/models")
+        assert status == 200 and body["data"][0]["id"] == "debug"
+        status, body = _call(port, "/v1/completions", "POST",
+                             {"model": "debug", "prompt": "hello",
+                              "max_tokens": 6, "temperature": 0})
+        assert status == 201
+        assert body["object"] == "text_completion"
+        assert body["usage"]["completion_tokens"] == 6
+        assert body["choices"][0]["finish_reason"] == "length"
+        status, body = _call(port, "/v1/chat/completions", "POST",
+                             {"model": "debug", "max_tokens": 4,
+                              "messages": [{"role": "user",
+                                            "content": "hi there"}]})
+        assert status == 201
+        assert body["object"] == "chat.completion"
+        assert body["choices"][0]["message"]["role"] == "assistant"
+        status, _ = _call(port, "/v1/chat/completions", "POST",
+                          {"messages": []})
+        assert status == 400
+        # streaming: OpenAI SSE chunks terminated by data: [DONE]
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/completions", method="POST",
+            data=json.dumps({"prompt": "stream", "max_tokens": 4,
+                             "stream": True}).encode())
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            assert resp.headers["Content-Type"].startswith("text/event-stream")
+            events = [line[6:] for line in
+                      resp.read().decode().splitlines()
+                      if line.startswith("data: ")]
+        assert events[-1] == "[DONE]"
+        parsed = [json.loads(e) for e in events[:-1]]
+        assert parsed[-1]["choices"][0]["finish_reason"] == "length"
+        assert any(c["choices"][0].get("text") for c in parsed)
+    finally:
+        app.shutdown()
+
+
+def test_openai_server_stop_strings_and_errors():
+    module = _load("openai-server")
+    app = module.build_app(config=_cfg(TPU_PLATFORM="cpu",
+                                       MODEL_PRESET="debug", WARMUP="false"))
+    app.start()
+    try:
+        port = app.http_port
+        # deterministic stop-string: generate once, pick a mid-substring
+        status, body = _call(port, "/v1/completions", "POST",
+                             {"prompt": "sss", "max_tokens": 12,
+                              "temperature": 0})
+        assert status == 201
+        full = body["choices"][0]["text"]
+        assert len(full) > 3
+        stop = full[2:4]
+        status, body = _call(port, "/v1/completions", "POST",
+                             {"prompt": "sss", "max_tokens": 12,
+                              "temperature": 0, "stop": stop})
+        assert status == 201
+        truncated = body["choices"][0]["text"]
+        assert stop not in truncated and full.startswith(truncated)
+        assert body["choices"][0]["finish_reason"] == "stop"
+        # streaming honors the same stop string
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/completions", method="POST",
+            data=json.dumps({"prompt": "sss", "max_tokens": 12,
+                             "temperature": 0, "stop": stop,
+                             "stream": True}).encode())
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            events = [line[6:] for line in resp.read().decode().splitlines()
+                      if line.startswith("data: ")]
+        assert events[-1] == "[DONE]"
+        parsed = [json.loads(e) for e in events[:-1]]
+        streamed = "".join(c["choices"][0]["text"] for c in parsed)
+        assert streamed == truncated
+        assert parsed[-1]["choices"][0]["finish_reason"] == "stop"
+        # parameter errors are 400s, not 500s
+        status, _ = _call(port, "/v1/completions", "POST",
+                          {"prompt": "x", "max_tokens": "abc"})
+        assert status == 400
+        status, _ = _call(port, "/v1/completions", "POST",
+                          {"prompt": "y" * 4000, "max_tokens": 2})
+        assert status == 400  # context_length_exceeded, not truncation
+    finally:
+        app.shutdown()
+
+
+def test_draining_engine_returns_503():
+    module = _load("llm-server")
+    app = __import__("gofr_tpu").App(config=_cfg(TPU_PLATFORM="cpu",
+                                                 MODEL_PRESET="debug",
+                                                 WARMUP="false"))
+    engine = module.build_engine(app)
+
+    @app.post("/gen")
+    def gen(ctx):
+        tok = engine.tokenizer
+        req = engine.submit(tok.encode("x"), max_new_tokens=2)
+        return {"n": len(req.result(timeout_s=30))}
+
+    app.start()
+    try:
+        status, _ = _call(app.http_port, "/gen", "POST", {})
+        assert status == 201
+        assert engine.drain(timeout_s=60)
+        status, body = _call(app.http_port, "/gen", "POST", {})
+        assert status == 503, body
+    finally:
+        engine.stop()
+        app.shutdown()
