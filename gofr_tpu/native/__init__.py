@@ -11,6 +11,7 @@ API:
   BPECore(merge_triples)   — id-level greedy BPE merges (hot encode loop)
   pad_batch(rows, max_len, pad_id) -> np.ndarray[int32]
   utf8_complete_prefix(buf) -> int
+  propose_draft(history, d) -> list[int]  — speculative prompt-lookup scan
 """
 
 from __future__ import annotations
@@ -59,6 +60,9 @@ def _bind(lib) -> None:
     lib.gn_utf8_complete_prefix.restype = ctypes.c_int32
     lib.gn_utf8_complete_prefix.argtypes = [ctypes.POINTER(ctypes.c_uint8),
                                             ctypes.c_int32]
+    lib.gn_propose_draft.restype = ctypes.c_int32
+    lib.gn_propose_draft.argtypes = [_i32p, ctypes.c_int32, ctypes.c_int32,
+                                     _i32p]
 
 
 def _load():
@@ -75,7 +79,10 @@ def _load():
             lib = ctypes.CDLL(_SO)
             _bind(lib)
             _lib = lib
-        except OSError:
+        except (OSError, AttributeError):
+            # AttributeError: a stale cached .so missing a newly added
+            # symbol (same-second mtimes can defeat the rebuild check) —
+            # degrade to the pure-Python paths, never crash the consumer
             _lib = None
         return _lib
 
@@ -177,3 +184,21 @@ def utf8_complete_prefix(buf: bytes) -> int:
     arr = (ctypes.c_uint8 * len(buf)).from_buffer_copy(buf) if buf else \
         (ctypes.c_uint8 * 1)()
     return lib.gn_utf8_complete_prefix(arr, len(buf))
+
+
+def propose_draft(history, d: int) -> Optional[List[int]]:
+    """Prompt-lookup draft: tokens that followed the most recent earlier
+    occurrence of history's trailing bigram (speculative decoding's host
+    side). Returns None when the library is missing (callers fall back to
+    the pure-Python scan in the engine)."""
+    lib = _load()
+    if lib is None:
+        return None
+    n = len(history)
+    if n < 3 or d <= 0:
+        return []
+    hist = np.ascontiguousarray(np.asarray(history, dtype=np.int32))
+    out = np.empty(d, dtype=np.int32)
+    count = lib.gn_propose_draft(hist.ctypes.data_as(_i32p), n, d,
+                                 out.ctypes.data_as(_i32p))
+    return out[:count].tolist()

@@ -109,6 +109,27 @@ int32_t gn_pad_batch(const int32_t* flat, const int64_t* lengths,
   return 0;
 }
 
+// Prompt-lookup draft proposal (speculative decoding): find the most recent
+// earlier occurrence of the history's trailing bigram and copy up to `d`
+// tokens that followed it into `out`. Returns tokens written (0 = no match).
+// Runs once per active slot per verify dispatch — at 128 slots and serving
+// dispatch rates the pure-Python scan is interpreter-bound.
+int32_t gn_propose_draft(const int32_t* hist, int32_t n, int32_t d,
+                         int32_t* out) {
+  if (n < 3 || d <= 0) return 0;
+  const int32_t a = hist[n - 2], b = hist[n - 1];
+  for (int32_t i = n - 3; i >= 0; --i) {
+    if (hist[i] == a && hist[i + 1] == b) {
+      const int32_t start = i + 2;  // <= n-1, so at least one token follows
+      const int32_t avail = n - start;
+      const int32_t count = avail < d ? avail : d;
+      std::memcpy(out, hist + start, count * sizeof(int32_t));
+      return count;
+    }
+  }
+  return 0;
+}
+
 // Length of the longest prefix of buf[0..len) that ends on a UTF-8 codepoint
 // boundary. Invalid lead bytes count as complete (replacement on decode).
 int32_t gn_utf8_complete_prefix(const uint8_t* buf, int32_t len) {

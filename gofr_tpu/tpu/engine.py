@@ -1022,10 +1022,17 @@ class LLMEngine:
     def _propose_draft(self, history: List[int]) -> List[int]:
         """Prompt-lookup draft: find the most recent earlier occurrence of
         the sequence's last bigram and propose the tokens that followed it.
-        O(len(history)) host work per slot per dispatch — negligible next to
-        a device dispatch. Empty when the sequence has no self-match (the
-        verify then degrades to an ordinary one-token step for that slot)."""
+        O(len(history)) host work per slot per dispatch, once per active
+        slot at serving dispatch rates — the native scan (gn_propose_draft)
+        keeps it out of the interpreter; pure Python is the fallback. Empty
+        when the sequence has no self-match (the verify then degrades to an
+        ordinary one-token step for that slot)."""
+        from .. import native
+
         d = self.speculative_tokens
+        cont = native.propose_draft(history, d)
+        if cont is not None:
+            return cont
         n = 2
         if len(history) < n + 1:
             return []

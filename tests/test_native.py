@@ -140,3 +140,37 @@ def test_utf8_complete_prefix_matches_python_fallback():
         # incomplete (valid-prefix cases)
         if got < len(buf):
             buf[:got].decode("utf-8")
+
+
+def test_propose_draft_matches_python_scan():
+    """Native prompt-lookup must agree with the engine's pure-Python
+    fallback on random histories."""
+    import random
+
+    from gofr_tpu import native
+
+    if not native.available():
+        pytest.skip("no C++ toolchain")
+
+    def py_scan(history, d):
+        n = 2
+        if len(history) < n + 1:
+            return []
+        tail = history[-n:]
+        for i in range(len(history) - n - 1, -1, -1):
+            if history[i:i + n] == tail:
+                return history[i + n: i + n + d]
+        return []
+
+    rng = random.Random(0)
+    for trial in range(200):
+        length = rng.randint(0, 60)
+        vocab = rng.choice([2, 3, 8, 100])
+        history = [rng.randrange(vocab) for _ in range(length)]
+        d = rng.choice([1, 4, 8])
+        assert native.propose_draft(history, d) == py_scan(history, d), \
+            (history, d)
+    # degenerate inputs
+    assert native.propose_draft([], 4) == []
+    assert native.propose_draft([1, 2], 4) == []
+    assert native.propose_draft([1, 2, 3], 0) == []
