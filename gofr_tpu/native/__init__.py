@@ -67,6 +67,8 @@ def _bind(lib) -> None:
 
 def _load():
     global _lib, _load_attempted
+    if _lib is not None:  # fast path: no lock once loaded (hot callers)
+        return _lib
     with _load_lock:
         if _lib is not None or _load_attempted:
             return _lib
@@ -75,15 +77,26 @@ def _load():
                                        os.path.getmtime(_SRC) > os.path.getmtime(_SO)):
             if not _build():
                 return None
-        try:
-            lib = ctypes.CDLL(_SO)
-            _bind(lib)
-            _lib = lib
-        except (OSError, AttributeError):
-            # AttributeError: a stale cached .so missing a newly added
-            # symbol (same-second mtimes can defeat the rebuild check) —
-            # degrade to the pure-Python paths, never crash the consumer
-            _lib = None
+        for attempt in (0, 1):
+            try:
+                lib = ctypes.CDLL(_SO)
+                _bind(lib)
+                _lib = lib
+                break
+            except (OSError, AttributeError):
+                # AttributeError: a stale cached .so missing a newly added
+                # symbol (same-second mtimes can defeat the rebuild check).
+                # Delete the stale artifact and rebuild ONCE — a silent
+                # permanent fallback would also disable the helpers the
+                # stale library did support (BPE, pad_batch)
+                _lib = None
+                if attempt == 0:
+                    try:
+                        os.remove(_SO)
+                    except OSError:
+                        break
+                    if not _build():
+                        break
         return _lib
 
 

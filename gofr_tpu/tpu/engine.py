@@ -304,6 +304,10 @@ class LLMEngine:
         # start depends on this one's acceptance), so spec mode runs one
         # dispatch at a time.
         self.speculative_tokens = max(0, int(speculative_tokens))
+        # bind once at boot: _propose_draft runs per active slot per verify
+        # dispatch, so no per-call module lookup on that path
+        self._native_propose = (native.propose_draft
+                                if native.available() else None)
         if self.speculative_tokens:
             if self._q8:
                 raise ValueError("speculative_tokens with kv_dtype='int8' "
@@ -1027,12 +1031,9 @@ class LLMEngine:
         keeps it out of the interpreter; pure Python is the fallback. Empty
         when the sequence has no self-match (the verify then degrades to an
         ordinary one-token step for that slot)."""
-        from .. import native
-
         d = self.speculative_tokens
-        cont = native.propose_draft(history, d)
-        if cont is not None:
-            return cont
+        if self._native_propose is not None:
+            return self._native_propose(history, d)
         n = 2
         if len(history) < n + 1:
             return []
