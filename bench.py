@@ -195,8 +195,14 @@ class _Record:
         # final parseable record
         self._lock = threading.Lock()
 
-    def update(self, value=None, **extras):
+    def update(self, value=None, rename_metric=None, **extras):
+        """rename_metric=(old, new) applies INSIDE the same locked emit as
+        the value, so no thread (the watchdog exits at arbitrary moments)
+        can ever observe the new name paired with the old value."""
         with self._lock:
+            if rename_metric is not None:
+                old, new = rename_metric
+                self.result["metric"] = self.result["metric"].replace(old, new)
             if value is not None:
                 self.result["value"] = round(value, 1)
                 self.result["vs_baseline"] = round(value / BASELINE_TOK_S, 3)
@@ -213,13 +219,6 @@ class _Record:
             self.result["metric"] = re.sub(r"_bs\d+_", f"_bs{n_slots}_",
                                            self.result["metric"])
 
-    def rename(self, old: str, new: str):
-        """In-place metric-name substitution WITHOUT emitting — call before
-        the update() that carries the renamed value, so no intermediate
-        line ever pairs the new value with the old name (the watchdog can
-        exit between any two emissions)."""
-        with self._lock:
-            self.result["metric"] = self.result["metric"].replace(old, new)
 
 
 def main() -> None:
@@ -237,8 +236,18 @@ def main() -> None:
 
     from gofr_tpu.models.llama import LlamaConfig, llama_init
     from gofr_tpu.tpu.capacity import (device_budget_bytes, kv_cache_bytes,
-                                       params_bytes)
+                                       kv_scales_bytes, params_bytes)
     from gofr_tpu.tpu.engine import LLMEngine
+
+    def _roofline_tok_s(use_cfg, eng) -> float:
+        """Decode reads weights + both caches every step: tok/s ceiling at
+        the v5e HBM bandwidth for this engine's ACTUAL allocation."""
+        per_step = (params_bytes(use_cfg)
+                    + kv_cache_bytes(use_cfg, eng.n_slots, eng._cache_len,
+                                     dtype=use_cfg.kv_dtype))
+        if use_cfg.kv_dtype == "int8":
+            per_step += kv_scales_bytes(use_cfg, eng.n_slots, eng._cache_len)
+        return V5E_HBM_GBPS * 1e9 * eng.n_slots / per_step
 
     import dataclasses
 
@@ -412,19 +421,16 @@ def main() -> None:
         tok_s, tokens, elapsed, t0_ttfts = phase_t0(engine)
     print(f"[bench] T0 short-prompt decode: {tokens} tok in {elapsed:.2f}s = "
           f"{tok_s:.1f} tok/s", file=sys.stderr)
-    # analytic HBM-roofline context: weights + BOTH caches are read every
-    # decode step; use the cache length the phase actually ran at (it grows
-    # during T0 to cover prompt + max_new + pipeline margin)
-    weights = params_bytes(cfg)
-    t0_cache = kv_cache_bytes(cfg, engine.n_slots, engine._cache_len)
-    roofline_tok_s = (V5E_HBM_GBPS * 1e9 * engine.n_slots
-                      / (weights + t0_cache)) if on_tpu else 0.0
+    # analytic HBM-roofline context: use the cache length the phase
+    # actually ran at (it grows during T0 to cover prompt + max_new +
+    # pipeline margin)
+    roofline_tok_s = _roofline_tok_s(cfg, engine) if on_tpu else 0.0
     record.update(value=tok_s,
                   t0_elapsed_s=round(elapsed, 2),
                   slots=engine.n_slots,
                   **_engine_percentiles(),
                   **({"roofline_tok_s": round(roofline_tok_s, 1),
-                      "model_gib": round(weights / 2**30, 2),
+                      "model_gib": round(params_bytes(cfg) / 2**30, 2),
                       "t0_cache_len": engine._cache_len,
                       "roofline_frac": round(tok_s / roofline_tok_s, 3)}
                      if roofline_tok_s else {}))
@@ -476,23 +482,16 @@ def main() -> None:
             else:
                 candidate.stop()
         if best_tag != "xla":
-            # rename FIRST (no emit), then one update carrying the new
-            # value + refreshed roofline: no intermediate line can pair
-            # the variant's value with the baseline's name or roofline
-            if cfg.kv_dtype == "int8":
-                record.rename("_bf16", "_int8kv")
-            weights = params_bytes(cfg)
-            t0_cache = kv_cache_bytes(cfg, engine.n_slots, engine._cache_len,
-                                      dtype=cfg.kv_dtype)
-            if cfg.kv_dtype == "int8":  # f32 dequant scales ride along
-                t0_cache += (2 * cfg.n_layers * engine.n_slots
-                             * cfg.n_kv_heads * engine._cache_len * 4)
-            roofline_tok_s = (V5E_HBM_GBPS * 1e9 * engine.n_slots
-                              / (weights + t0_cache))
+            # ONE locked emission carries the rename + the winning value +
+            # its refreshed roofline: the watchdog can never snapshot the
+            # new name against the baseline's value or roofline
+            roofline = _roofline_tok_s(cfg, engine)
             record.update(value=best_tok_s, decode_impl=best_tag,
-                          roofline_tok_s=round(roofline_tok_s, 1),
+                          rename_metric=(("_bf16", "_int8kv")
+                                         if cfg.kv_dtype == "int8" else None),
+                          roofline_tok_s=round(roofline, 1),
                           t0_cache_len=engine._cache_len,
-                          roofline_frac=round(best_tok_s / roofline_tok_s, 3))
+                          roofline_frac=round(best_tok_s / roofline, 3))
         else:
             record.update(decode_impl=best_tag)
 

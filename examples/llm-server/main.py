@@ -78,7 +78,19 @@ def build_engine(app: App) -> LLMEngine:
     # config 5: Llama-70B TP=8 on v5e-8) — same engine, sharded mesh
     tp = app.config.get_int("TP_SHARDS", 1)
     mesh = tpu.mesh({"tp": tp}, allow_subset=True) if tp > 1 else None
-    engine = LLMEngine(
+    # PAGED=true serves from the paged KV pool (block tables + page
+    # allocator + scalar-prefetch Pallas read) instead of the dense
+    # per-slot cache; PAGE_SIZE tokens per page, N_PAGES caps the pool
+    engine_cls, paged_kw = LLMEngine, {}
+    if app.config.get_bool("PAGED", False):
+        from gofr_tpu.tpu.paging import PagedLLMEngine
+
+        engine_cls = PagedLLMEngine
+        paged_kw = {"page_size": app.config.get_int("PAGE_SIZE", 128)}
+        n_pages = app.config.get_int("N_PAGES", 0)
+        if n_pages:
+            paged_kw["n_pages"] = n_pages
+    engine = engine_cls(
         params, cfg,
         n_slots=app.config.get_int("MAX_BATCH", 8),
         max_seq_len=app.config.get_int("MAX_SEQ_LEN", 1024),
@@ -98,6 +110,7 @@ def build_engine(app: App) -> LLMEngine:
         # tokens verified per dispatch; greedy output is identical, wins
         # come on self-repetitive text (RAG, code edits, summaries)
         speculative_tokens=app.config.get_int("SPECULATIVE_TOKENS", 0),
+        **paged_kw,
     )
     engine.tokenizer = tokenizer
     engine.start()

@@ -78,6 +78,7 @@ def _load():
             if not _build():
                 return None
         for attempt in (0, 1):
+            lib = None
             try:
                 lib = ctypes.CDLL(_SO)
                 _bind(lib)
@@ -91,6 +92,16 @@ def _load():
                 # stale library did support (BPE, pad_batch)
                 _lib = None
                 if attempt == 0:
+                    if lib is not None:
+                        # dlopen dedups by pathname: without closing the
+                        # failed handle, the retry's CDLL would rebind the
+                        # SAME stale in-memory image, not the rebuilt file
+                        try:
+                            import _ctypes
+
+                            _ctypes.dlclose(lib._handle)
+                        except Exception:  # noqa: BLE001
+                            break
                     try:
                         os.remove(_SO)
                     except OSError:

@@ -16,9 +16,14 @@ gathered cache (an XLA gather would copy the whole live cache every step).
 Online softmax (m, l, acc) carries in VMEM scratch across the page axis,
 exactly like ops/flash_attention's streaming kernel.
 
-Grid: (B, Hkv, NP) with NP innermost so the softmax carry is per-(b, h).
-Pages past a slot's live length are skipped with pl.when (their table
-entries point at page 0; the fetch happens, the compute doesn't).
+Grid: COARSE (B, NP) with NP innermost — one grid step covers ALL Hkv
+heads of one page (per-head dots unroll in Python inside the body), the
+lesson ops/decode_attention's module docstring records: a (B, Hkv, page)
+grid's per-step launch overhead dominated the tiny per-step compute.
+Pages past a slot's live length re-select its LAST live page in the
+index map; Pallas skips the copy when consecutive steps map to the same
+block, so per-row HBM traffic tracks live pages, and their compute is
+skipped with pl.when.
 
 The XLA `paged_attention_reference` (gather-based) is the numerics oracle
 and the CPU fallback.
@@ -60,14 +65,19 @@ def paged_attention_reference(q, k_pool, v_pool, table, lengths):
 
 
 def _paged_kernel(table_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
-                  m_scr, l_scr, acc_scr, *, page_size: int, scale: float):
-    """One (b, h, p) grid step: fold page p into the (b, h) online softmax."""
+                  m_scr, l_scr, acc_scr, *, page_size: int, n_kv: int,
+                  scale: float):
+    """One (b, p) grid step: fold page p (ALL heads) into the online
+    softmax. Heads unroll in Python — the coarse grid keeps per-step
+    launch overhead amortized over Hkv head-dots."""
     from jax.experimental import pallas as pl
 
     b = pl.program_id(0)
-    p = pl.program_id(2)
-    n_pages = pl.num_programs(2)
+    p = pl.program_id(1)
+    n_pages = pl.num_programs(1)
     length = len_ref[b]
+    G = q_ref.shape[2]
+    dh = q_ref.shape[3]
 
     @pl.when(p == 0)
     def _init():
@@ -77,29 +87,33 @@ def _paged_kernel(table_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
 
     @pl.when(p * page_size < length)
     def _compute():
-        q = q_ref[0, 0]                                   # [G, dh]
-        k = k_ref[0, 0]                                   # [dh, ps]
-        v = v_ref[0, 0]
-        s = jax.lax.dot_general(q, k, (((1,), (0,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
         kv_pos = p * page_size + jax.lax.broadcasted_iota(
-            jnp.int32, s.shape, 1)
-        s = jnp.where(kv_pos < length, s, DEFAULT_MASK_VALUE)
-        m_prev = m_scr[:]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-        pr = jnp.exp(s - m_new)
-        alpha = jnp.exp(m_prev - m_new)
-        m_scr[:] = m_new
-        l_scr[:] = l_scr[:] * alpha + jnp.sum(pr, axis=-1, keepdims=True)
-        pv = jax.lax.dot_general(pr.astype(v.dtype), v,
-                                 (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        acc_scr[:] = acc_scr[:] * alpha + pv
+            jnp.int32, (G, page_size), 1)
+        mask = kv_pos < length
+        for h in range(n_kv):                             # unrolled heads
+            q = q_ref[0, h]                               # [G, dh]
+            k = k_ref[0, h]                               # [dh, ps]
+            v = v_ref[0, h]
+            s = jax.lax.dot_general(q, k, (((1,), (0,)), ((), ())),
+                                    preferred_element_type=jnp.float32) * scale
+            s = jnp.where(mask, s, DEFAULT_MASK_VALUE)
+            row = slice(h * G, (h + 1) * G)
+            m_prev = m_scr[row]
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+            pr = jnp.exp(s - m_new)
+            alpha = jnp.exp(m_prev - m_new)
+            m_scr[row] = m_new
+            l_scr[row] = l_scr[row] * alpha + jnp.sum(pr, axis=-1,
+                                                      keepdims=True)
+            pv = jax.lax.dot_general(pr.astype(v.dtype), v,
+                                     (((1,), (1,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+            acc_scr[row] = acc_scr[row] * alpha + pv
 
     @pl.when(p == n_pages - 1)
     def _finalize():
-        o_ref[0, 0] = (acc_scr[:] / jnp.maximum(l_scr[:], 1e-30)
-                       ).astype(o_ref.dtype)
+        o_ref[0] = (acc_scr[:] / jnp.maximum(l_scr[:], 1e-30)
+                    ).reshape(n_kv, G, dh).astype(o_ref.dtype)
 
 
 def paged_attention(q, k_pool, v_pool, table, lengths, *, interpret=None):
@@ -107,7 +121,9 @@ def paged_attention(q, k_pool, v_pool, table, lengths, *, interpret=None):
     table: [B, NP] int32; lengths: [B] int32. Returns [B, H, dh].
 
     Dead table entries (p*ps >= lengths[b]) must hold a VALID page id
-    (0 is fine): their fetch still happens, their compute is skipped.
+    (0 is fine); the index map re-selects the row's last live page for
+    them, so consecutive dead steps skip their DMA entirely and their
+    compute is skipped via pl.when.
     """
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -120,25 +136,31 @@ def paged_attention(q, k_pool, v_pool, table, lengths, *, interpret=None):
         interpret = jax.default_backend() != "tpu"
 
     qg = q.reshape(B, Hkv, G, dh)
-    kernel = functools.partial(_paged_kernel, page_size=ps,
+    kernel = functools.partial(_paged_kernel, page_size=ps, n_kv=Hkv,
                                scale=1.0 / math.sqrt(dh))
+
+    def page_index(b, p, table, lens):
+        # LIVE-PAGE DMA CLAMP (see ops/decode_attention.kv_index): dead
+        # steps re-select the last live page; equal consecutive block
+        # indices skip the copy
+        last_live = jnp.maximum((lens[b] + ps - 1) // ps - 1, 0)
+        return (table[b, jnp.minimum(p, last_live)], 0, 0, 0)
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,  # table, lengths
-        grid=(B, Hkv, NP),
+        grid=(B, NP),
         in_specs=[
-            pl.BlockSpec((1, 1, G, dh),
-                         lambda b, h, p, table, lens: (b, h, 0, 0)),
-            pl.BlockSpec((1, 1, dh, ps),
-                         lambda b, h, p, table, lens: (table[b, p], h, 0, 0)),
-            pl.BlockSpec((1, 1, dh, ps),
-                         lambda b, h, p, table, lens: (table[b, p], h, 0, 0)),
+            pl.BlockSpec((1, Hkv, G, dh),
+                         lambda b, p, table, lens: (b, 0, 0, 0)),
+            pl.BlockSpec((1, Hkv, dh, ps), page_index),
+            pl.BlockSpec((1, Hkv, dh, ps), page_index),
         ],
-        out_specs=pl.BlockSpec((1, 1, G, dh),
-                               lambda b, h, p, table, lens: (b, h, 0, 0)),
+        out_specs=pl.BlockSpec((1, Hkv, G, dh),
+                               lambda b, p, table, lens: (b, 0, 0, 0)),
         scratch_shapes=[
-            pltpu.VMEM((G, 1), jnp.float32),
-            pltpu.VMEM((G, 1), jnp.float32),
-            pltpu.VMEM((G, dh), jnp.float32),
+            pltpu.VMEM((Hkv * G, 1), jnp.float32),
+            pltpu.VMEM((Hkv * G, 1), jnp.float32),
+            pltpu.VMEM((Hkv * G, dh), jnp.float32),
         ],
     )
     out = pl.pallas_call(

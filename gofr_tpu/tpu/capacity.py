@@ -77,6 +77,11 @@ def params_bytes(cfg) -> int:
     return cfg.param_count() * _dtype_bytes(cfg.dtype)
 
 
+def kv_scales_bytes(cfg, n_slots: int, seq_len: int) -> int:
+    """The int8 cache's f32 dequant-scale buffers: 2 * [L, B, Hkv, S]."""
+    return 2 * cfg.n_layers * n_slots * cfg.n_kv_heads * seq_len * 4
+
+
 def prefill_temp_bytes(cfg, k_max: int, bucket_max: int) -> int:
     """Worst-case fused-admission temporaries for a [K, bucket] prefill.
 
@@ -129,8 +134,7 @@ def plan_capacity(cfg, n_slots: int, max_seq_len: int,
         kv_dtype = getattr(cfg, "kv_dtype", None)
         cache = kv_cache_bytes(cfg, slots, seq, dtype=kv_dtype)
         if kv_dtype == "int8":
-            # per-token f32 dequant scales: 2 * [L, B, Hkv, S]
-            cache += (2 * cfg.n_layers * slots * cfg.n_kv_heads * seq * 4)
+            cache += kv_scales_bytes(cfg, slots, seq)
         # dense decode ping-pongs the scanned cache carries (one extra
         # cache-sized pair); this also covers the smaller one-off grow copy.
         # the paged pool is never carried whole, so it has no such transient

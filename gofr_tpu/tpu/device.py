@@ -87,6 +87,7 @@ class TPUClient:
             ("app_tpu_requests_total", "inference requests admitted"),
             ("app_tpu_spec_drafted_total", "speculative draft tokens proposed"),
             ("app_tpu_spec_accepted_total", "speculative draft tokens accepted"),
+            ("app_tpu_page_waits_total", "admissions deferred on page-pool exhaustion"),
         ):
             try:
                 m.new_counter(name, desc)
@@ -98,6 +99,7 @@ class TPUClient:
             ("app_tpu_hbm_bytes_used", "HBM bytes in use per device"),
             ("app_tpu_hbm_bytes_limit", "HBM bytes available per device"),
             ("app_tpu_tokens_per_second", "rolling decode throughput"),
+            ("app_tpu_pages_used", "KV pool pages currently owned by slots"),
         ):
             try:
                 m.new_gauge(name, desc)
