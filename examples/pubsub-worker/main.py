@@ -27,9 +27,27 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
 
 from gofr_tpu import App  # noqa: E402
 
-sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                "..", "llm-server"))
-from main import build_engine  # noqa: E402  (the llm-server's engine builder)
+import importlib.util  # noqa: E402
+
+
+def _load_llm_server():
+    """Import the llm-server example under a UNIQUE module name: a bare
+    `import main` would collide with whatever other example's main.py is
+    already cached in sys.modules."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "..", "llm-server", "main.py")
+    cached = sys.modules.get("example_llm_server_engine")
+    if cached is not None:
+        return cached
+    spec = importlib.util.spec_from_file_location("example_llm_server_engine",
+                                                  path)
+    module = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = module   # cache: one shared instance per process
+    spec.loader.exec_module(module)
+    return module
+
+
+build_engine = _load_llm_server().build_engine  # the llm-server's builder
 
 
 def main() -> None:

@@ -49,8 +49,9 @@ class LlamaConfig:
     # None (= cfg.dtype) | "int8" — the serving KV cache's storage dtype.
     # int8 halves cache HBM bytes (the decode bandwidth bound) and doubles
     # context capacity per GiB; values quantize on write with per-token
-    # per-head scales and dequantize inside the decode kernel's dots
-    # (requires decode_attn == "kernel"; dense engine only)
+    # per-head scales and dequantize inside the decode kernels' dots.
+    # Dense engine: requires decode_attn == "kernel". Paged engine: the
+    # paged kernel dequant-folds natively (pool + page capacity both halve)
     kv_dtype: Optional[str] = None
 
     @property
@@ -726,6 +727,64 @@ def llama_decode_step_paged(params, cfg: LlamaConfig, tokens, positions,
     x = rms_norm(x, params["final_norm"], cfg.rms_eps)
     logits = (x[:, 0] @ params["lm_head"]).astype(jnp.float32)
     return logits, k_pool, v_pool
+
+
+def llama_decode_step_paged_q8(params, cfg: LlamaConfig, tokens, positions,
+                               k_pool, v_pool, ks_pool, vs_pool, table):
+    """One decode step against an INT8 paged KV pool.
+
+    MIRRORS llama_decode_step_paged with per-token scales: k/v_pool are
+    [L, P, Hkv, dh, ps] int8, ks/vs_pool [L, P, Hkv, ps] float32. The new
+    token's K/V quantize on write; the paged kernel reads the int8 pages
+    with dequant folded into its dots — pool HBM bytes halve, so both the
+    per-step read AND the page capacity per GiB double.
+    Returns (logits [B, V] f32, k_pool, v_pool, ks_pool, vs_pool).
+    """
+    from ..ops.decode_attention import quantize_kv
+    from ..ops.paged_attention import paged_attention, paged_write_decode
+
+    B = tokens.shape[0]
+    H, Hkv, dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    x = params["tok_emb"][tokens][:, None]                 # [B, 1, D]
+    pos_grid = positions[:, None]
+    ps = k_pool.shape[-1]
+    # scale writes share the value writer's index rule (paged_write_decode)
+    page_ids = table[jnp.arange(B), positions // ps]       # [B]
+    offsets = positions % ps
+
+    def layer_body(l, state):
+        x, k_pool, v_pool, ks_pool, vs_pool = state
+        layer = jax.tree_util.tree_map(lambda w: w[l], params["layers"])
+        kp_l = jax.lax.dynamic_index_in_dim(k_pool, l, 0, keepdims=False)
+        vp_l = jax.lax.dynamic_index_in_dim(v_pool, l, 0, keepdims=False)
+        ksp_l = jax.lax.dynamic_index_in_dim(ks_pool, l, 0, keepdims=False)
+        vsp_l = jax.lax.dynamic_index_in_dim(vs_pool, l, 0, keepdims=False)
+        normed = rms_norm(x, layer["attn_norm"], cfg.rms_eps)
+        q = rope((normed @ layer["wq"]).reshape(B, 1, H, dh), pos_grid,
+                 cfg.rope_theta)
+        k = rope((normed @ layer["wk"]).reshape(B, 1, Hkv, dh), pos_grid,
+                 cfg.rope_theta)
+        v = (normed @ layer["wv"]).reshape(B, 1, Hkv, dh)
+        k8, ks = quantize_kv(k[:, 0], axis=-1)             # [B,Hkv,dh],[B,Hkv]
+        v8, vs = quantize_kv(v[:, 0], axis=-1)
+        kp_l, vp_l = paged_write_decode(kp_l, vp_l, k8, v8, table, positions)
+        ksp_l = ksp_l.at[page_ids, :, offsets].set(ks)
+        vsp_l = vsp_l.at[page_ids, :, offsets].set(vs)
+        attn = paged_attention(q[:, 0], kp_l, vp_l, table, positions + 1,
+                               ksp_l, vsp_l)
+        x = x + (attn.reshape(B, 1, H * dh) @ layer["wo"])
+        x = x + _ffn_block(x, layer, cfg)
+        k_pool = jax.lax.dynamic_update_index_in_dim(k_pool, kp_l, l, 0)
+        v_pool = jax.lax.dynamic_update_index_in_dim(v_pool, vp_l, l, 0)
+        ks_pool = jax.lax.dynamic_update_index_in_dim(ks_pool, ksp_l, l, 0)
+        vs_pool = jax.lax.dynamic_update_index_in_dim(vs_pool, vsp_l, l, 0)
+        return x, k_pool, v_pool, ks_pool, vs_pool
+
+    x, k_pool, v_pool, ks_pool, vs_pool = jax.lax.fori_loop(
+        0, cfg.n_layers, layer_body, (x, k_pool, v_pool, ks_pool, vs_pool))
+    x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+    logits = (x[:, 0] @ params["lm_head"]).astype(jnp.float32)
+    return logits, k_pool, v_pool, ks_pool, vs_pool
 
 
 def _attention_block_nocache(x, layer, positions, cfg: LlamaConfig,

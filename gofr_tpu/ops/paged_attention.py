@@ -40,37 +40,55 @@ import jax.numpy as jnp
 DEFAULT_MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
 
 
-def paged_attention_reference(q, k_pool, v_pool, table, lengths):
+def paged_attention_reference(q, k_pool, v_pool, table, lengths,
+                              k_scale=None, v_scale=None):
     """Gather-based oracle. q: [B, H, dh]; pools: [P, Hkv, dh, ps];
     table: [B, NP] int32 page ids; lengths: [B] live tokens per slot
-    (including the current token). Returns [B, H, dh] in q.dtype."""
+    (including the current token). k/v_scale: optional [P, Hkv, ps]
+    per-token dequant scales for int8 pools. Returns [B, H, dh] in
+    q.dtype."""
     B, H, dh = q.shape
     P, Hkv, _, ps = k_pool.shape
     NP = table.shape[1]
     G = H // Hkv
 
-    k = k_pool[table]                     # [B, NP, Hkv, dh, ps]
-    v = v_pool[table]
+    k = k_pool[table].astype(jnp.float32)  # [B, NP, Hkv, dh, ps]
+    v = v_pool[table].astype(jnp.float32)
+    if k_scale is not None:
+        k = k * k_scale[table][:, :, :, None, :].astype(jnp.float32)
+    if v_scale is not None:
+        v = v * v_scale[table][:, :, :, None, :].astype(jnp.float32)
     k = jnp.moveaxis(k, 1, 3).reshape(B, Hkv, dh, NP * ps)
     v = jnp.moveaxis(v, 1, 3).reshape(B, Hkv, dh, NP * ps)
 
     qg = q.reshape(B, Hkv, G, dh).astype(jnp.float32)
-    s = jnp.einsum("bhgd,bhds->bhgs", qg, k.astype(jnp.float32)) / math.sqrt(dh)
+    s = jnp.einsum("bhgd,bhds->bhgs", qg, k) / math.sqrt(dh)
     pos = jnp.arange(NP * ps)[None, :]                    # [1, S]
     s = jnp.where((pos < lengths[:, None])[:, None, None, :], s,
                   DEFAULT_MASK_VALUE)
     p = jax.nn.softmax(s, axis=-1)
-    out = jnp.einsum("bhgs,bhds->bhgd", p, v.astype(jnp.float32))
+    out = jnp.einsum("bhgs,bhds->bhgd", p, v)
     return out.reshape(B, H, dh).astype(q.dtype)
 
 
-def _paged_kernel(table_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
-                  m_scr, l_scr, acc_scr, *, page_size: int, n_kv: int,
-                  scale: float):
+def _paged_kernel(table_ref, len_ref, *refs, page_size: int, n_kv: int,
+                  scale: float, quantized: bool):
     """One (b, p) grid step: fold page p (ALL heads) into the online
     softmax. Heads unroll in Python — the coarse grid keeps per-step
-    launch overhead amortized over Hkv head-dots."""
+    launch overhead amortized over Hkv head-dots.
+
+    quantized=False refs: (q, k, v, o, m, l, acc)
+    quantized=True  refs: (q, k, v, k_scale, v_scale, o, m, l, acc) — int8
+    pages with per-token scales; dequant FOLDS into the dots exactly like
+    ops/decode_attention's quantized kernel (k's scale multiplies score
+    rows, v's folds into the probabilities)."""
     from jax.experimental import pallas as pl
+
+    if quantized:
+        q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr = refs
+        ks_ref = vs_ref = None
 
     b = pl.program_id(0)
     p = pl.program_id(1)
@@ -94,8 +112,12 @@ def _paged_kernel(table_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
             q = q_ref[0, h]                               # [G, dh]
             k = k_ref[0, h]                               # [dh, ps]
             v = v_ref[0, h]
+            if quantized:
+                k = k.astype(jnp.bfloat16)                # in-VMEM upcast
             s = jax.lax.dot_general(q, k, (((1,), (0,)), ((), ())),
                                     preferred_element_type=jnp.float32) * scale
+            if quantized:
+                s = s * ks_ref[0, h][None, :].astype(jnp.float32)
             s = jnp.where(mask, s, DEFAULT_MASK_VALUE)
             row = slice(h * G, (h + 1) * G)
             m_prev = m_scr[row]
@@ -105,6 +127,9 @@ def _paged_kernel(table_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
             m_scr[row] = m_new
             l_scr[row] = l_scr[row] * alpha + jnp.sum(pr, axis=-1,
                                                       keepdims=True)
+            if quantized:
+                pr = pr * vs_ref[0, h][None, :].astype(jnp.float32)
+                v = v.astype(jnp.bfloat16)
             pv = jax.lax.dot_general(pr.astype(v.dtype), v,
                                      (((1,), (1,)), ((), ())),
                                      preferred_element_type=jnp.float32)
@@ -116,9 +141,13 @@ def _paged_kernel(table_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
                     ).reshape(n_kv, G, dh).astype(o_ref.dtype)
 
 
-def paged_attention(q, k_pool, v_pool, table, lengths, *, interpret=None):
+def paged_attention(q, k_pool, v_pool, table, lengths, k_scale=None,
+                    v_scale=None, *, interpret=None):
     """Paged decode attention. q: [B, H, dh]; pools: [P, Hkv, dh, ps];
     table: [B, NP] int32; lengths: [B] int32. Returns [B, H, dh].
+
+    k/v_scale: optional [P, Hkv, ps] per-token dequant scales — pass both
+    to read int8 pools (the int8 bytes are what cross HBM).
 
     Dead table entries (p*ps >= lengths[b]) must hold a VALID page id
     (0 is fine); the index map re-selects the row's last live page for
@@ -132,12 +161,16 @@ def paged_attention(q, k_pool, v_pool, table, lengths, *, interpret=None):
     P, Hkv, _, ps = k_pool.shape
     NP = table.shape[1]
     G = H // Hkv
+    quantized = k_scale is not None
+    if quantized != (v_scale is not None):
+        raise ValueError("pass both k_scale and v_scale or neither")
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
 
     qg = q.reshape(B, Hkv, G, dh)
     kernel = functools.partial(_paged_kernel, page_size=ps, n_kv=Hkv,
-                               scale=1.0 / math.sqrt(dh))
+                               scale=1.0 / math.sqrt(dh),
+                               quantized=quantized)
 
     def page_index(b, p, table, lens):
         # LIVE-PAGE DMA CLAMP (see ops/decode_attention.kv_index): dead
@@ -146,15 +179,26 @@ def paged_attention(q, k_pool, v_pool, table, lengths, *, interpret=None):
         last_live = jnp.maximum((lens[b] + ps - 1) // ps - 1, 0)
         return (table[b, jnp.minimum(p, last_live)], 0, 0, 0)
 
+    def scale_index(b, p, table, lens):
+        last_live = jnp.maximum((lens[b] + ps - 1) // ps - 1, 0)
+        return (table[b, jnp.minimum(p, last_live)], 0, 0)
+
+    in_specs = [
+        pl.BlockSpec((1, Hkv, G, dh),
+                     lambda b, p, table, lens: (b, 0, 0, 0)),
+        pl.BlockSpec((1, Hkv, dh, ps), page_index),
+        pl.BlockSpec((1, Hkv, dh, ps), page_index),
+    ]
+    operands = [table, lengths, qg, k_pool, v_pool]
+    if quantized:
+        in_specs += [pl.BlockSpec((1, Hkv, ps), scale_index),
+                     pl.BlockSpec((1, Hkv, ps), scale_index)]
+        operands += [k_scale, v_scale]
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,  # table, lengths
         grid=(B, NP),
-        in_specs=[
-            pl.BlockSpec((1, Hkv, G, dh),
-                         lambda b, p, table, lens: (b, 0, 0, 0)),
-            pl.BlockSpec((1, Hkv, dh, ps), page_index),
-            pl.BlockSpec((1, Hkv, dh, ps), page_index),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, Hkv, G, dh),
                                lambda b, p, table, lens: (b, 0, 0, 0)),
         scratch_shapes=[
@@ -168,7 +212,7 @@ def paged_attention(q, k_pool, v_pool, table, lengths, *, interpret=None):
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, Hkv, G, dh), q.dtype),
         interpret=interpret,
-    )(table, lengths, qg, k_pool, v_pool)
+    )(*operands)
     return out.reshape(B, H, dh)
 
 
@@ -188,23 +232,34 @@ def paged_write_decode(k_pool, v_pool, k, v, table, positions):
     return k_pool, v_pool
 
 
-def paged_write_prefill_stacked(k_pool, v_pool, tmp_k, tmp_v, table, lengths):
-    """Scatter a prefill window's K/V into the stacked page pool.
-
-    k/v_pool: [L, P, Hkv, dh, ps]; tmp_k/v: [L, K, Hkv, dh, T] fresh window
-    entries at positions [0..T) (the serving prefill's tmp-cache layout);
-    table: [K, NP]; lengths: [K] true prompt lengths — positions >= length
-    scatter into the reserved GARBAGE page (pool page 0, the PageAllocator
-    invariant) so pad junk never lands in a live page.
-    Returns updated (k_pool, v_pool).
-    """
-    _, P, _, _, ps = k_pool.shape
-    K, T = table.shape[0], tmp_k.shape[-1]
+def _prefill_scatter_indices(table, lengths, T: int, ps: int):
+    """(page_ids [K, T], offsets [K, T]) for scattering a prefill window
+    into pages: token t of row k goes to (table[k, t // ps], t % ps), and
+    positions >= lengths[k] divert to the reserved GARBAGE page (pool page
+    0, the PageAllocator invariant) so pad junk never lands in a live page.
+    ONE implementation on purpose — values and scales must scatter by the
+    identical rule or dequantization silently mismatches."""
+    K = table.shape[0]
     pos = jnp.arange(T, dtype=jnp.int32)[None, :]          # [1, T]
     page_slot = jnp.broadcast_to(pos // ps, (K, T))
     page_ids = jnp.take_along_axis(table, page_slot, axis=1)  # [K, T]
     page_ids = jnp.where(pos < lengths[:, None], page_ids, jnp.int32(0))
     offsets = jnp.broadcast_to(pos % ps, (K, T))
+    return page_ids, offsets
+
+
+def paged_write_prefill_stacked(k_pool, v_pool, tmp_k, tmp_v, table, lengths):
+    """Scatter a prefill window's K/V into the stacked page pool.
+
+    k/v_pool: [L, P, Hkv, dh, ps]; tmp_k/v: [L, K, Hkv, dh, T] fresh window
+    entries at positions [0..T) (the serving prefill's tmp-cache layout);
+    table: [K, NP]; lengths: [K] true prompt lengths (pad junk diverts to
+    the garbage page — see _prefill_scatter_indices).
+    Returns updated (k_pool, v_pool).
+    """
+    ps = k_pool.shape[-1]
+    page_ids, offsets = _prefill_scatter_indices(table, lengths,
+                                                 tmp_k.shape[-1], ps)
     # advanced indices on pool dims 1 and 4 (non-adjacent -> result dims
     # lead) -> value shape [K, T, L, Hkv, dh]
     val_k = tmp_k.transpose(1, 4, 0, 2, 3)
@@ -212,6 +267,18 @@ def paged_write_prefill_stacked(k_pool, v_pool, tmp_k, tmp_v, table, lengths):
     k_pool = k_pool.at[:, page_ids, :, :, offsets].set(val_k)
     v_pool = v_pool.at[:, page_ids, :, :, offsets].set(val_v)
     return k_pool, v_pool
+
+
+def paged_write_prefill_scales(s_pool, tmp_s, table, lengths):
+    """Scatter a prefill window's per-token dequant scales into the stacked
+    scale pool. s_pool: [L, P, Hkv, ps]; tmp_s: [L, K, Hkv, T]; table:
+    [K, NP]; lengths: [K]. Shares the value writer's index rule."""
+    ps = s_pool.shape[-1]
+    page_ids, offsets = _prefill_scatter_indices(table, lengths,
+                                                 tmp_s.shape[-1], ps)
+    # advanced indices on pool dims 1 and 3 -> value shape [K, T, L, Hkv]
+    val = tmp_s.transpose(1, 3, 0, 2)
+    return s_pool.at[:, page_ids, :, offsets].set(val)
 
 
 def paged_write_prefill(k_pool, v_pool, k, v, table, lengths):

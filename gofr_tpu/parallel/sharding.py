@@ -102,6 +102,12 @@ def kv_scale_layer_spec():
     return _P(None, "tp", None)
 
 
+def kv_scale_pool_spec():
+    """Stacked paged scale pool [L, P, Hkv, ps]: KV heads over tp,
+    row-aligned with kv_cache_spec."""
+    return _P(None, None, "tp", None)
+
+
 def batch_spec():
     """Token batches [B, T]: batch over dp, sequence over sp."""
     return _P("dp", "sp")

@@ -290,7 +290,9 @@ class LLMEngine:
                              f"use None or 'int8'")
         self._q8 = cfg.kv_dtype == "int8"
         if self._q8:
-            if cfg.decode_attn != "kernel":
+            # the paged engine's decode read is ALWAYS its paged kernel, so
+            # the dense-path requirement doesn't apply there
+            if cfg.decode_attn != "kernel" and not self._plan_paged:
                 raise ValueError("kv_dtype='int8' requires decode_attn="
                                  "'kernel' (no efficient XLA dequant read)")
 

@@ -98,9 +98,6 @@ class PagedLLMEngine(LLMEngine):
             # arbitrary pages — reject loudly rather than corrupt
             raise ValueError("chunked prefill is not supported by the paged "
                              "engine yet (dense LLMEngine only)")
-        if getattr(cfg, "kv_dtype", None) == "int8":
-            raise ValueError("kv_dtype='int8' is not supported by the paged "
-                             "engine yet (dense LLMEngine only)")
         if kw.get("speculative_tokens"):
             raise ValueError("speculative decoding is not supported by the "
                              "paged engine yet (dense LLMEngine only)")
@@ -124,12 +121,16 @@ class PagedLLMEngine(LLMEngine):
         self._cache_len = self.max_seq_len  # admission_limit compatibility
         L, Hkv, dh = self.cfg.n_layers, self.cfg.n_kv_heads, self.cfg.head_dim
         dt = {"bfloat16": jnp.bfloat16, "float32": jnp.float32,
-              "float16": jnp.float16}[self.cfg.dtype]
+              "float16": jnp.float16, "int8": jnp.int8}[
+                  self.cfg.kv_dtype or self.cfg.dtype]
         # the capacity plan (budget_bytes, paged=True) clamped n_slots and
         # max_seq_len; the pool derived from them must itself fit — check
         # explicitly, since an explicit n_pages bypasses the plan's sizing
-        pool_bytes = (2 * L * n_pages * Hkv * dh * ps
-                      * {"bfloat16": 2, "float16": 2}.get(self.cfg.dtype, 4))
+        itemsize = {"bfloat16": 2, "float16": 2, "int8": 1}.get(
+            self.cfg.kv_dtype or self.cfg.dtype, 4)
+        pool_bytes = 2 * L * n_pages * Hkv * dh * ps * itemsize
+        if self._q8:  # f32 dequant scale pools ride along
+            pool_bytes += 2 * L * n_pages * Hkv * ps * 4
         if self.plan is not None:
             usable = int(self.plan.budget_bytes * 0.92)
             need = (self.plan.params_bytes + pool_bytes
@@ -141,6 +142,10 @@ class PagedLLMEngine(LLMEngine):
                     f"= {need >> 20} MiB > {usable >> 20} MiB usable")
         self.k_cache = jnp.zeros((L, n_pages, Hkv, dh, ps), dtype=dt)
         self.v_cache = jnp.zeros_like(self.k_cache)
+        self.k_scale = self.v_scale = None
+        if self._q8:
+            self.k_scale = jnp.zeros((L, n_pages, Hkv, ps), dtype=jnp.float32)
+            self.v_scale = jnp.zeros_like(self.k_scale)
         B = self.n_slots
         self._tokens = jnp.zeros((B,), dtype=jnp.int32)
         self._positions = jnp.zeros((B,), dtype=jnp.int32)
@@ -162,13 +167,22 @@ class PagedLLMEngine(LLMEngine):
         rep = NamedSharding(self.mesh, PartitionSpec())
         self.k_cache = jax.device_put(self.k_cache, cache_s)
         self.v_cache = jax.device_put(self.v_cache, cache_s)
+        if self._q8:
+            from ..parallel.sharding import kv_scale_pool_spec
+
+            scale_s = NamedSharding(self.mesh, kv_scale_pool_spec())
+            self.k_scale = jax.device_put(self.k_scale, scale_s)
+            self.v_scale = jax.device_put(self.v_scale, scale_s)
         self._tokens = jax.device_put(self._tokens, rep)
         self._positions = jax.device_put(self._positions, rep)
         self._temps = jax.device_put(self._temps, rep)
         self.rng = jax.device_put(self.rng, rep)
 
     def pool_bytes(self) -> int:
-        return 2 * self.k_cache.size * self.k_cache.dtype.itemsize
+        total = 2 * self.k_cache.size * self.k_cache.dtype.itemsize
+        if self.k_scale is not None:  # int8: f32 scale pools are pool bytes too
+            total += 2 * self.k_scale.size * self.k_scale.dtype.itemsize
+        return total
 
     def _grow_cache(self, needed: int) -> None:
         """Paged pool never grows — capacity is the page budget."""
@@ -275,9 +289,62 @@ class PagedLLMEngine(LLMEngine):
 
         return prefill
 
+    def _prefill_fn_q8(self, bucket: int, K: int):
+        """MIRRORS the paged _prefill_fn with int8 pools + scale pools:
+        full-precision window forward into bf16 temps, quantize per
+        token/head, scatter values and scales into the pages."""
+        cfg = self.cfg
+        jnp = self._jnp
+        top_k = self.top_k
+        from ..models.llama import _np_dtype
+        from ..ops.decode_attention import quantize_kv
+        from ..ops.paged_attention import paged_write_prefill_scales
+        from .sampling import sample_tokens
+
+        def prefill(params, k_pool, v_pool, k_scale, v_scale, ptokens,
+                    ptable, slots, lengths, tokens, positions, temps,
+                    new_temps, rng):
+            L, P, Hkv, dh, _ = k_pool.shape
+            k_pool, v_pool = _pin_standard_layout(k_pool, v_pool)
+            tmp_k = jnp.zeros((L, K, Hkv, dh, bucket),
+                              dtype=_np_dtype(cfg.dtype))
+            tmp_v = jnp.zeros_like(tmp_k)
+            pos_grid = jnp.broadcast_to(
+                jnp.arange(bucket, dtype=jnp.int32)[None, :], (K, bucket))
+            last, tmp_k, tmp_v = llama_prefill_last(
+                params, cfg, ptokens, pos_grid, lengths, tmp_k, tmp_v)
+            k8, ks = quantize_kv(tmp_k, axis=-2)   # scales [L, K, Hkv, bucket]
+            v8, vs = quantize_kv(tmp_v, axis=-2)
+            k_pool, v_pool = paged_write_prefill_stacked(
+                k_pool, v_pool, k8, v8, ptable, lengths)
+            k_scale = paged_write_prefill_scales(k_scale, ks, ptable, lengths)
+            v_scale = paged_write_prefill_scales(v_scale, vs, ptable, lengths)
+            first, rng = sample_tokens(last, rng, new_temps, top_k=top_k)
+            tokens = tokens.at[slots].set(first)
+            positions = positions.at[slots].set(lengths)
+            temps = temps.at[slots].set(new_temps)
+            k_pool, v_pool = _pin_standard_layout(k_pool, v_pool)
+            return (k_pool, v_pool, k_scale, v_scale, tokens, positions,
+                    temps, rng, first)
+
+        return prefill
+
     def _prefill_program(self, bucket: int, K: int):
         jnp = self._jnp
         n_ptable = max(1, math.ceil(bucket / self.page_size))
+        if self._q8:
+            args = (self.params, self.k_cache, self.v_cache, self.k_scale,
+                    self.v_scale,
+                    jnp.zeros((K, bucket), dtype=jnp.int32),
+                    jnp.zeros((K, n_ptable), dtype=jnp.int32),
+                    jnp.zeros((K,), dtype=jnp.int32),
+                    jnp.ones((K,), dtype=jnp.int32),
+                    self._tokens, self._positions, self._temps,
+                    jnp.zeros((K,), dtype=jnp.float32), self.rng)
+            return self.executor.compile(
+                f"llama-paged-prefill-q8-{bucket}x{K}",
+                self._prefill_fn_q8(bucket, K),
+                args, donate_argnums=(1, 2, 3, 4, 9, 10, 11))
         args = (self.params, self.k_cache, self.v_cache,
                 jnp.zeros((K, bucket), dtype=jnp.int32),
                 jnp.zeros((K, n_ptable), dtype=jnp.int32),
@@ -317,9 +384,46 @@ class PagedLLMEngine(LLMEngine):
 
         return decode
 
+    def _decode_fn_paged_q8(self, block: int, n_table: int):
+        """MIRRORS _decode_fn_paged over int8 pools + scale pools."""
+        cfg = self.cfg
+        top_k = self.top_k
+        import jax
+
+        from ..models.llama import llama_decode_step_paged_q8
+        from .sampling import sample_tokens
+
+        def decode(params, k_pool, v_pool, k_scale, v_scale, table, tokens,
+                   positions, temps, rng):
+            def step(carry, _):
+                kp, vp, ks, vs, tok, pos, rng = carry
+                logits, kp, vp, ks, vs = llama_decode_step_paged_q8(
+                    params, cfg, tok, pos, kp, vp, ks, vs, table)
+                nxt, rng = sample_tokens(logits, rng, temps, top_k=top_k)
+                return (kp, vp, ks, vs, nxt, pos + 1, rng), nxt
+
+            k_pool, v_pool = _pin_standard_layout(k_pool, v_pool)
+            (k_pool, v_pool, k_scale, v_scale, tok, pos, rng), out = \
+                jax.lax.scan(step, (k_pool, v_pool, k_scale, v_scale,
+                                    tokens, positions, rng), None,
+                             length=block)
+            k_pool, v_pool = _pin_standard_layout(k_pool, v_pool)
+            return k_pool, v_pool, k_scale, v_scale, tok, pos, rng, out.T
+
+        return decode
+
     def _decode_program_paged(self, n_table: int, block: Optional[int] = None):
         jnp = self._jnp
         block = block or self.decode_block_size
+        if self._q8:
+            args = (self.params, self.k_cache, self.v_cache, self.k_scale,
+                    self.v_scale,
+                    jnp.zeros((self.n_slots, n_table), dtype=jnp.int32),
+                    self._tokens, self._positions, self._temps, self.rng)
+            return self.executor.compile(
+                f"llama-paged-decode-q8-x{block}-NP{n_table}",
+                self._decode_fn_paged_q8(block, n_table), args,
+                donate_argnums=(1, 2, 3, 4))
         args = (self.params, self.k_cache, self.v_cache,
                 jnp.zeros((self.n_slots, n_table), dtype=jnp.int32),
                 self._tokens, self._positions, self._temps, self.rng)
@@ -348,13 +452,23 @@ class PagedLLMEngine(LLMEngine):
 
         program = self._prefill_program(bucket, K)
         try:
-            (self.k_cache, self.v_cache, self._tokens, self._positions,
-             self._temps, self.rng, first) = program(
-                self.params, self.k_cache, self.v_cache,
-                jnp.asarray(ptokens), jnp.asarray(ptable),
-                jnp.asarray(np.asarray(slots_idx, dtype=np.int32)),
-                jnp.asarray(lengths), self._tokens, self._positions,
-                self._temps, jnp.asarray(new_temps), self.rng)
+            if self._q8:
+                (self.k_cache, self.v_cache, self.k_scale, self.v_scale,
+                 self._tokens, self._positions, self._temps, self.rng,
+                 first) = program(
+                    self.params, self.k_cache, self.v_cache, self.k_scale,
+                    self.v_scale, jnp.asarray(ptokens), jnp.asarray(ptable),
+                    jnp.asarray(np.asarray(slots_idx, dtype=np.int32)),
+                    jnp.asarray(lengths), self._tokens, self._positions,
+                    self._temps, jnp.asarray(new_temps), self.rng)
+            else:
+                (self.k_cache, self.v_cache, self._tokens, self._positions,
+                 self._temps, self.rng, first) = program(
+                    self.params, self.k_cache, self.v_cache,
+                    jnp.asarray(ptokens), jnp.asarray(ptable),
+                    jnp.asarray(np.asarray(slots_idx, dtype=np.int32)),
+                    jnp.asarray(lengths), self._tokens, self._positions,
+                    self._temps, jnp.asarray(new_temps), self.rng)
         except Exception as exc:
             raise CacheLostError(f"paged prefill dispatch failed: {exc}") from exc
 
@@ -384,10 +498,19 @@ class PagedLLMEngine(LLMEngine):
         snapshot = [(i, slot.request) for i, slot in active]
         start = _time.time()
         try:
-            (self.k_cache, self.v_cache, self._tokens, self._positions,
-             self.rng, out_tokens) = program(
-                self.params, self.k_cache, self.v_cache, jnp.asarray(table),
-                self._tokens, self._positions, self._temps, self.rng)
+            if self._q8:
+                (self.k_cache, self.v_cache, self.k_scale, self.v_scale,
+                 self._tokens, self._positions, self.rng, out_tokens) = \
+                    program(self.params, self.k_cache, self.v_cache,
+                            self.k_scale, self.v_scale, jnp.asarray(table),
+                            self._tokens, self._positions, self._temps,
+                            self.rng)
+            else:
+                (self.k_cache, self.v_cache, self._tokens, self._positions,
+                 self.rng, out_tokens) = program(
+                    self.params, self.k_cache, self.v_cache,
+                    jnp.asarray(table), self._tokens, self._positions,
+                    self._temps, self.rng)
         except Exception as exc:
             raise CacheLostError(f"paged decode dispatch failed: {exc}") from exc
         dspan = self._dispatch_span("tpu.decode", next(self._batch_seq),

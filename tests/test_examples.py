@@ -249,7 +249,8 @@ def test_using_subscriber(running):
 def test_openai_server_example():
     module = _load("openai-server")
     app = module.build_app(config=_cfg(TPU_PLATFORM="cpu",
-                                       MODEL_PRESET="debug", WARMUP="false"))
+                                       MODEL_PRESET="debug", WARMUP="false",
+                                       REQUEST_TIMEOUT="60"))
     app.start()
     try:
         port = app.http_port
@@ -293,7 +294,8 @@ def test_openai_server_example():
 def test_openai_server_stop_strings_and_errors():
     module = _load("openai-server")
     app = module.build_app(config=_cfg(TPU_PLATFORM="cpu",
-                                       MODEL_PRESET="debug", WARMUP="false"))
+                                       MODEL_PRESET="debug", WARMUP="false",
+                                       REQUEST_TIMEOUT="60"))
     app.start()
     try:
         port = app.http_port
@@ -341,7 +343,8 @@ def test_draining_engine_returns_503():
     module = _load("llm-server")
     app = __import__("gofr_tpu").App(config=_cfg(TPU_PLATFORM="cpu",
                                                  MODEL_PRESET="debug",
-                                                 WARMUP="false"))
+                                                 WARMUP="false",
+                                                 REQUEST_TIMEOUT="60"))
     engine = module.build_engine(app)
 
     @app.post("/gen")

@@ -263,3 +263,66 @@ def test_paged_engine_streaming_and_stop_tokens():
         assert stop == want[:3]
     finally:
         eng.stop()
+
+
+def test_paged_q8_engine_matches_paged_fp_closely():
+    """INT8 paged pool: prefill is full-precision into the quantized splice
+    (first token exact vs the fp paged engine); decode reads dequant-folded
+    pages — near-ties may flip, bulk must agree, and pages must free."""
+    import dataclasses
+
+    cfg_q8 = dataclasses.replace(CFG, kv_dtype="int8")
+    prompts = [[1, 2, 3, 4, 5], list(range(7, 40)), [9]]
+
+    def serve(use_cfg):
+        params = llama_init(CFG, seed=0)
+        eng = PagedLLMEngine(params, use_cfg, page_size=16, n_slots=4,
+                             max_seq_len=128, prefill_buckets=(8, 64),
+                             decode_block_size=4)
+        eng.start()
+        try:
+            reqs = [eng.submit(p, max_new_tokens=10, temperature=0.0)
+                    for p in prompts]
+            outs = [r.result(timeout_s=300) for r in reqs]
+            import time as _t
+            deadline = _t.time() + 10
+            while eng.allocator.used_pages and _t.time() < deadline:
+                _t.sleep(0.02)
+            assert eng.allocator.used_pages == 0, "pages leaked"
+            return outs
+        finally:
+            eng.stop()
+
+    fp = serve(CFG)
+    q8 = serve(cfg_q8)
+    assert [len(t) for t in q8] == [len(t) for t in fp]
+    for f, q in zip(fp, q8):
+        assert f[0] == q[0]          # full-precision prefill: exact
+    total = sum(len(t) for t in fp)
+    agree = sum(a == b for f, q in zip(fp, q8) for a, b in zip(f, q))
+    assert agree / total > 0.6, f"only {agree}/{total} agree"
+    assert q8 == serve(cfg_q8)       # deterministic
+
+
+def test_paged_attention_int8_matches_reference():
+    from gofr_tpu.ops.decode_attention import quantize_kv
+    from gofr_tpu.ops.paged_attention import paged_attention_reference
+
+    rng = np.random.default_rng(5)
+    B, H, Hkv, dh, P, ps, NP = 3, 4, 2, 16, 9, 8, 4
+    q = jnp.asarray(rng.normal(size=(B, H, dh)), dtype=jnp.float32)
+    k = jnp.asarray(rng.normal(size=(P, Hkv, dh, ps)), dtype=jnp.float32)
+    v = jnp.asarray(rng.normal(size=(P, Hkv, dh, ps)), dtype=jnp.float32)
+    k8, ks = quantize_kv(k)     # axis=-2 (dh) -> scales [P, Hkv, ps]
+    v8, vs = quantize_kv(v)
+    table = jnp.asarray([[1, 2, 3, 4], [5, 6, 0, 0], [7, 8, 0, 0]],
+                        dtype=jnp.int32)
+    lens = jnp.asarray([29, 11, 16], dtype=jnp.int32)
+    ref = paged_attention_reference(q, k8, v8, table, lens, ks, vs)
+    out = paged_attention(q, k8, v8, table, lens, ks, vs)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=5e-2, atol=5e-2)
+    # close to the full-precision read too
+    exact = paged_attention_reference(q, k, v, table, lens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(exact),
+                               rtol=0.15, atol=0.15)
