@@ -191,6 +191,16 @@ class LLMEngine:
     # growth/ping-pong transient (its pool is fixed and never carried whole)
     _plan_paged = False
 
+    # adaptive-speculation tuning (class attrs so tests can tighten them):
+    # EMA smoothing of accepted-per-slot, the floor below which verify
+    # dispatches pause, and how many block-decode dispatches a cooloff lasts
+    SPEC_EMA_ALPHA = 0.2
+    SPEC_MIN_ACCEPT = 0.25
+    SPEC_COOLOFF_DISPATCHES = 16
+    # probes restart the EMA at 2x the floor: ~4-5 consecutive
+    # zero-acceptance verifies before re-cooling, one good one to recover
+    SPEC_PROBE_EMA = 0.5
+
     def __init__(
         self,
         params,
@@ -310,6 +320,14 @@ class LLMEngine:
         # dispatch, so no per-call module lookup on that path
         self._native_propose = (native.propose_draft
                                 if native.available() else None)
+        # ADAPTIVE speculation: a rolling accepted-tokens-per-slot estimate
+        # decides whether the next dispatch is a verify or a plain block
+        # decode. Low acceptance (random text) makes verify strictly worse
+        # than pipelined block decode — the engine cools off for a stretch
+        # of block dispatches, then probes again. Greedy output is
+        # identical either way; this only tunes throughput.
+        self._spec_accept_ema = float(self.speculative_tokens)  # optimistic
+        self._spec_cooloff = 0
         if self.speculative_tokens:
             if self._q8:
                 raise ValueError("speculative_tokens with kv_dtype='int8' "
@@ -609,10 +627,11 @@ class LLMEngine:
                     self._chunk_program(chunk, 1, first=False, final=False)
             if self.speculative_tokens:
                 self._verify_program()
-            else:
-                self._decode_program()
-                if self.decode_block_size > 1:  # adaptive short-block variant
-                    self._decode_program(max(1, self.decode_block_size // 2))
+            # adaptive cooloff (spec mode) falls back to exactly these
+            # block-decode programs: warm both variants either way
+            self._decode_program()
+            if self.decode_block_size > 1:  # adaptive short-block variant
+                self._decode_program(max(1, self.decode_block_size // 2))
 
     # -- compiled programs ----------------------------------------------------
     def _prefill_fn(self, bucket: int, K: int):
@@ -1020,7 +1039,10 @@ class LLMEngine:
         longest = max((slot.length for slot in self.slots if slot.active),
                       default=0)
         outstanding = len(self._inflight) + 1
-        per_dispatch = (self.speculative_tokens + 1
+        # adaptive spec interleaves verify (d+1 tokens) and block-decode
+        # dispatches: budget the larger of the two
+        per_dispatch = (max(self.speculative_tokens + 1,
+                            self.decode_block_size)
                         if self.speculative_tokens else self.decode_block_size)
         return longest + per_dispatch * outstanding + 1
 
@@ -1190,16 +1212,30 @@ class LLMEngine:
                     # long prompt's remaining chunks
                     self._advance_chunk_job()
                     any_active = any(slot.active for slot in self.slots)
-                    if self.speculative_tokens:
-                        # one verify at a time: the next window's start
-                        # position depends on this one's acceptance
-                        if any_active and not any(e[0] == "verify"
-                                                  for e in self._inflight):
+                    if self.speculative_tokens and self._spec_cooloff <= 0:
+                        # one verify at a time (the next window's start
+                        # depends on this one's acceptance), and NOT until
+                        # in-flight cooloff decodes drain — a verify
+                        # dispatched over unsynced decodes would propose
+                        # drafts from host state that lags the device
+                        if any_active and not any(
+                                e[0] in ("verify", "decode")
+                                for e in self._inflight):
                             self._dispatch_verify()
                     else:
                         while (any_active
                                and len(self._inflight) < self.pipeline_depth):
                             self._dispatch_decode()
+                            if self._spec_cooloff > 0:
+                                self._spec_cooloff -= 1
+                                if self._spec_cooloff == 0:
+                                    # probe window: a few bad verifies
+                                    # before re-cooling, one good enough
+                                    # to keep going
+                                    self._spec_accept_ema = max(
+                                        self._spec_accept_ema,
+                                        self.SPEC_PROBE_EMA)
+                                    break
                 if self._inflight:
                     self._sync_oldest()
                 elif not self._chunk_jobs:
@@ -1374,6 +1410,14 @@ class LLMEngine:
             # first sampled token is written at `length` by the next decode
             slot.length = len(request.prompt_tokens)
             slot.remaining = request.max_new_tokens - 1
+            if self.speculative_tokens and self._spec_cooloff > 0:
+                # fresh traffic probes immediately: the cold streak that
+                # engaged this cooloff belonged to DIFFERENT requests, and
+                # at block sizes x remaining-cooloff a short request could
+                # otherwise complete without speculation ever being tried
+                self._spec_cooloff = 0
+                self._spec_accept_ema = max(self._spec_accept_ema,
+                                            self.SPEC_PROBE_EMA)
             for span in (request.span, request.gen_span):
                 if span is not None:
                     span.set_attribute("batch.id", batch_id)
@@ -1512,13 +1556,16 @@ class LLMEngine:
                 dspan.end()
             elapsed = time.time() - started
             self._obs.hist("app_tpu_execute_seconds", elapsed)
-            emitted = n_active = 0
+            emitted = n_active = device_accepted = 0
             for slot_idx, request in snapshot:
                 slot = self.slots[slot_idx]
                 if slot.request is not request:
                     continue
                 n_active += 1
                 n = int(n_emit_host[slot_idx])
+                # DEVICE-side acceptance: host emission may truncate at
+                # stop tokens / budget, which must not read as rejection
+                device_accepted += max(0, n - 1)
                 self._obs.counter("app_tpu_spec_accepted_total",
                                   float(max(0, n - 1)))
                 for t in range(n):
@@ -1542,6 +1589,15 @@ class LLMEngine:
                                  emitted)
             self._obs.hist("app_tpu_batch_size", n_active)
             self._track_throughput(emitted)
+            # adaptive speculation: fold this dispatch's accepted-per-slot
+            # into the EMA; a cold streak pauses verifies for a stretch of
+            # pipelined block decodes (the loop probes again afterwards)
+            if n_active:
+                a = self.SPEC_EMA_ALPHA
+                self._spec_accept_ema = ((1 - a) * self._spec_accept_ema
+                                         + a * device_accepted / n_active)
+                if self._spec_accept_ema < self.SPEC_MIN_ACCEPT:
+                    self._spec_cooloff = self.SPEC_COOLOFF_DISPATCHES
             return
 
         _, out_tokens, snapshot, block, started, dspan = entry
@@ -1568,6 +1624,11 @@ class LLMEngine:
                 token = int(tokens_host[slot_idx, t])
                 slot.length += 1
                 slot.remaining -= 1
+                if slot.history is not None:
+                    # adaptive spec's cooloff runs block decodes: the draft
+                    # context must track THESE tokens too, or the next
+                    # probe's bigram lookup searches a stale history
+                    slot.history.append(token)
                 self._emit(request, token)
                 emitted += 1
                 if (token in request.stop_tokens or slot.remaining <= 0

@@ -111,3 +111,34 @@ def test_speculative_rejected_combinations():
         LLMEngine(params, CFG, n_slots=2, max_seq_len=64,
                   prefill_buckets=(8, 32), chunk_prefill_tokens=8,
                   speculative_tokens=4)
+
+
+def test_adaptive_speculation_cools_off_and_stays_correct():
+    """Non-repetitive prompts give low acceptance: the engine must fall
+    back to block decode (cooloff engages) while greedy output remains
+    identical to the plain engine."""
+    params = llama_init(CFG, seed=0)
+
+    class Tight(LLMEngine):
+        SPEC_EMA_ALPHA = 0.5
+        SPEC_MIN_ACCEPT = 0.6     # random text can't sustain this
+        SPEC_COOLOFF_DISPATCHES = 4
+
+    eng = Tight(params, CFG, n_slots=4, max_seq_len=128,
+                prefill_buckets=(8, 32, 64), decode_block_size=4,
+                speculative_tokens=4, seed=0)
+    eng.start()
+    cooled = False
+    try:
+        reqs = [eng.submit(p, max_new_tokens=24, temperature=0.0)
+                for p in PROMPTS]
+        import time as _t
+        deadline = _t.time() + 300
+        while any(r.finished_at is None for r in reqs) and _t.time() < deadline:
+            cooled = cooled or eng._spec_cooloff > 0
+            _t.sleep(0.005)
+        spec_out = [r.result(timeout_s=10) for r in reqs]
+    finally:
+        eng.stop()
+    assert cooled, "cooloff never engaged on low-acceptance traffic"
+    assert spec_out == _serve(PROMPTS, max_new=24, spec=0)
