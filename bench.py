@@ -326,6 +326,8 @@ def main() -> None:
                            ("app_tpu_execute_seconds", TPOT_BUCKETS),
                            ("app_tpu_batch_size", BATCH_BUCKETS)):
         manager.new_histogram(hname, hname, buckets)
+    for cname in ("app_tpu_spec_drafted_total", "app_tpu_spec_accepted_total"):
+        manager.new_counter(cname, cname)  # T2's acceptance diagnostics
 
     def _engine_percentiles():
         """p50s from the engine's own histograms (bucket-edge approx):
@@ -338,7 +340,7 @@ def main() -> None:
                 out[key] = round(hist.percentile(0.5) * 1e3, 2)
         return out
 
-    def make_engine(slots, seq, use_cfg):
+    def make_engine(slots, seq, use_cfg, **extra):
         # block/depth from a sweep on v5e: small blocks turn finished slots
         # over faster; depth 2 hides dispatch latency without inflating the
         # in-flight margin
@@ -347,7 +349,8 @@ def main() -> None:
                                               if b <= seq),
                         decode_block_size=8, pipeline_depth=2, seed=0,
                         budget_bytes=budget or None, metrics=manager,
-                        executor=Executor(cache_dir=cache_dir or None))
+                        executor=Executor(cache_dir=cache_dir or None),
+                        **extra)
         eng.start()
         try:
             # grow=False: T0 must run at the small boot-time allocation (the
@@ -568,6 +571,57 @@ def main() -> None:
         print(f"[bench] L failed (earlier results preserved): {exc}",
               file=sys.stderr)
         record.update(l_error=f"{type(exc).__name__}: {exc}"[:200])
+
+    # ---- T2: structured-text speculation (labeled extra, never headline) --
+    # Speculative decoding cannot help the random-token phases (no self-
+    # repetition to draft from), so measure it on an honest STRUCTURED
+    # workload: prompts built by tiling a motif, the shape of RAG answers /
+    # code edits. The same workload runs on the current engine first so the
+    # comparison is same-hardware same-shapes.
+    try:
+        if engine is not None and full_run and _left() > 300:
+            def motif_prompts(n):
+                out = []
+                for _ in range(n):
+                    motif = rng.integers(1, cfg.vocab_size, size=24).tolist()
+                    out.append((motif * 8)[:engine.admission_limit])
+                return out
+
+            sprompts = motif_prompts(engine.n_slots)
+            plain_tok_s, _, _, _ = run_phase_throughput(
+                engine, sprompts, max_new, rounds=1)
+            engine.stop()
+            engine = None
+            # speculation composes with the kernel read but not (yet) the
+            # int8 cache: strip kv_dtype if the q8 variant won T0v
+            spec_cfg = dataclasses.replace(cfg, kv_dtype=None)
+            spec_eng = make_engine(n_slots, max_seq, spec_cfg,
+                                   speculative_tokens=4)
+            try:
+                spec_tok_s, _, _, _ = run_phase_throughput(
+                    spec_eng, sprompts, max_new, rounds=1)
+                drafted = manager.get("app_tpu_spec_drafted_total")
+                accepted = manager.get("app_tpu_spec_accepted_total")
+                d_total = sum(drafted.series.values()) if drafted else 0
+                a_total = sum(accepted.series.values()) if accepted else 0
+                print(f"[bench] T2 structured: plain {plain_tok_s:.1f} vs "
+                      f"spec {spec_tok_s:.1f} tok/s "
+                      f"(accepted {a_total:.0f}/{d_total:.0f} drafts)",
+                      file=sys.stderr)
+                record.update(
+                    t2_structured_plain_tok_s=round(plain_tok_s, 1),
+                    t2_structured_spec_tok_s=round(spec_tok_s, 1),
+                    t2_spec_accept_rate=round(a_total / d_total, 3)
+                    if d_total else 0.0)
+            finally:
+                spec_eng.stop()
+        elif full_run:
+            record.update(t2_skipped=("engine lost in an earlier phase"
+                                      if engine is None else "budget"))
+    except Exception as exc:  # noqa: BLE001 - keep earlier phases' record
+        print(f"[bench] T2 failed (earlier results preserved): {exc}",
+              file=sys.stderr)
+        record.update(t2_error=f"{type(exc).__name__}: {exc}"[:200])
 
     if engine is not None:
         try:
