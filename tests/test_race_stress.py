@@ -105,41 +105,48 @@ def test_broker_concurrent_publish_consume_no_loss_no_dup():
                                   for i in range(N_PUB) for j in range(PER))
 
 
-def test_engine_concurrent_submit_stream_cancel():
-    """Many client threads submitting/streaming/cancelling against one
-    engine: every request either completes with its own deterministic
-    tokens or raises cleanly — no cross-request leakage, no hang."""
+def _engine_submit_cancel_stress(engine_kwargs, prompts, max_new,
+                                 n_threads, rounds, cancel_mod):
+    """Shared body: many client threads submitting/streaming/cancelling
+    against one engine — every request either completes with its own
+    deterministic tokens or raises cleanly; no cross-request leakage."""
     from gofr_tpu.models.llama import LlamaConfig, llama_init
     from gofr_tpu.tpu.engine import LLMEngine
 
     cfg = LlamaConfig.debug()
-    eng = LLMEngine(llama_init(cfg, seed=0), cfg, n_slots=4, max_seq_len=64,
-                    prefill_buckets=(8,), logger=MockLogger())
+    eng = LLMEngine(llama_init(cfg, seed=0), cfg, logger=MockLogger(),
+                    **engine_kwargs)
     eng.start()
     try:
-        # golden outputs per prompt, computed single-threaded
-        prompts = {i: [1 + i, 2 + i, 3 + i] for i in range(6)}
-        golden = {i: eng.generate(p, max_new_tokens=6, temperature=0.0)
+        golden = {i: eng.generate(p, max_new_tokens=max_new, temperature=0.0)
                   for i, p in prompts.items()}
 
         def work(i):
             prompt = prompts[i % len(prompts)]
-            for round_no in range(4):
-                req = eng.submit(prompt, max_new_tokens=6, temperature=0.0)
-                if (i + round_no) % 3 == 0:
+            for round_no in range(rounds):
+                req = eng.submit(prompt, max_new_tokens=max_new,
+                                 temperature=0.0)
+                if (i + round_no) % cancel_mod == 0:
                     req.cancel()
                     try:
-                        req.result(timeout_s=60)
+                        req.result(timeout_s=90)
                     except Exception:  # noqa: BLE001 - cancel may race finish
                         pass
                 else:
-                    out = req.result(timeout_s=60)
+                    out = req.result(timeout_s=90)
                     assert out == golden[i % len(prompts)], \
                         f"cross-request leakage for {i}"
 
-        _hammer(12, work)
+        _hammer(n_threads, work)
     finally:
         eng.stop()
+
+
+def test_engine_concurrent_submit_stream_cancel():
+    _engine_submit_cancel_stress(
+        dict(n_slots=4, max_seq_len=64, prefill_buckets=(8,)),
+        prompts={i: [1 + i, 2 + i, 3 + i] for i in range(6)},
+        max_new=6, n_threads=12, rounds=4, cancel_mod=3)
 
 
 def test_executor_concurrent_compile_single_program():
@@ -160,3 +167,65 @@ def test_executor_concurrent_compile_single_program():
     assert all(p is results[0] for p in results)
     np.testing.assert_array_equal(np.asarray(results[0](jnp.ones((4,)))),
                                   np.full((4,), 2.0))
+
+
+def test_spec_engine_concurrent_submit_cancel():
+    """The speculative engine's extra host state (histories, EMA, cooloff)
+    under the same hammering."""
+    _engine_submit_cancel_stress(
+        dict(n_slots=4, max_seq_len=128, prefill_buckets=(8, 16),
+             speculative_tokens=3),
+        prompts={i: [5 + i, 6 + i] * 3 for i in range(4)},
+        max_new=8, n_threads=10, rounds=3, cancel_mod=4)
+
+
+def test_drain_races_concurrent_submitters():
+    """drain() firing while many threads submit: every submit either
+    completes fully or fails with the draining error — nothing hangs,
+    nothing half-generates."""
+    from gofr_tpu.models.llama import LlamaConfig, llama_init
+    from gofr_tpu.tpu.engine import EngineDrainingError, LLMEngine
+
+    cfg = LlamaConfig.debug()
+    eng = LLMEngine(llama_init(cfg, seed=0), cfg, n_slots=4, max_seq_len=64,
+                    prefill_buckets=(8,), logger=MockLogger())
+    eng.start()
+    outcomes = []
+    lock = threading.Lock()
+    try:
+        eng.generate([1, 2, 3], max_new_tokens=4, temperature=0.0)  # warm
+
+        stop_submitting = threading.Event()
+
+        def work(i):
+            if i == 0:
+                # the drainer: let submitters get going, then drain
+                import time as _t
+                _t.sleep(0.3)
+                drained = eng.drain(timeout_s=120)
+                stop_submitting.set()
+                assert drained, "drain timed out: busy state leaked"
+                return
+            while not stop_submitting.is_set():
+                try:
+                    req = eng.submit([1 + i, 2, 3], max_new_tokens=4,
+                                     temperature=0.0)
+                except EngineDrainingError:
+                    with lock:
+                        outcomes.append("rejected")
+                    return
+                try:
+                    out = req.result(timeout_s=120)
+                    with lock:
+                        outcomes.append(len(out))
+                except EngineDrainingError:
+                    with lock:
+                        outcomes.append("failed-queued")
+
+        _hammer(8, work)
+    finally:
+        eng.stop()
+    # every completed generation is FULL length; partial outputs would mean
+    # drain cut an active request short
+    assert all(o == 4 for o in outcomes if isinstance(o, int)), outcomes
+    assert outcomes, "no submitter ever ran"
