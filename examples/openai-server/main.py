@@ -22,7 +22,7 @@ import uuid
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
 
 from gofr_tpu import App, Stream  # noqa: E402
-from gofr_tpu.http.errors import InvalidParam  # noqa: E402
+from gofr_tpu.http.errors import InvalidParam, RequestTimeout  # noqa: E402
 from gofr_tpu.http.responder import Raw  # noqa: E402
 
 import importlib.util  # noqa: E402
@@ -80,7 +80,7 @@ def build_app(**kw) -> App:
             raise InvalidParam(["stop"])
         return max_tokens, temperature, stop
 
-    def _submit(prompt: str, max_tokens: int, temperature: float):
+    def _encode_checked(prompt: str):
         prompt_tokens = tokenizer.encode(prompt)
         if len(prompt_tokens) > engine.admission_limit:
             # the OpenAI contract: context_length_exceeded is a 400, never
@@ -88,13 +88,71 @@ def build_app(**kw) -> App:
             raise InvalidParam(
                 [f"prompt: {len(prompt_tokens)} tokens exceeds the model "
                  f"context limit ({engine.admission_limit})"])
-        request = engine.submit(prompt_tokens, max_new_tokens=max_tokens,
-                                temperature=temperature,
-                                stop_tokens={tokenizer.EOS})
-        return request, prompt_tokens
+        return prompt_tokens
+
+    def _submit_tokens(prompt_tokens, max_tokens: int, temperature: float):
+        return engine.submit(prompt_tokens, max_new_tokens=max_tokens,
+                             temperature=temperature,
+                             stop_tokens={tokenizer.EOS})
+
+    def _submit(prompt: str, max_tokens: int, temperature: float):
+        prompt_tokens = _encode_checked(prompt)
+        return _submit_tokens(prompt_tokens, max_tokens, temperature), \
+            prompt_tokens
 
     def _finish_reason(n_emitted: int, max_tokens: int) -> str:
         return "length" if n_emitted >= max_tokens else "stop"
+
+    def _apply_stops(text: str, n_tokens: int, max_tokens: int, stop_strs):
+        finish = _finish_reason(n_tokens, max_tokens)
+        for s in stop_strs:
+            idx = text.find(s)
+            if idx >= 0:
+                text = text[:idx]
+                finish = "stop"
+        return text, finish
+
+    def _multi_completion(ctx, chat, prompt, n_choices, max_tokens,
+                          temperature, stop_strs):
+        """n > 1: fan the prompt out as n engine requests (they batch into
+        the same continuous-batching slots) and collect n choices. Encode
+        once; ANY failure cancels every sibling so abandoned requests
+        can't keep occupying decode slots."""
+        prompt_toks = _encode_checked(prompt)
+        requests = []
+        choices, total_out = [], 0
+        try:
+            for _ in range(n_choices):
+                requests.append(_submit_tokens(prompt_toks, max_tokens,
+                                               temperature))
+            for idx, req in enumerate(requests):
+                try:
+                    tokens = req.result(timeout_s=ctx.remaining())
+                except TimeoutError as exc:
+                    raise RequestTimeout() from exc
+                total_out += len(tokens)
+                text, finish = _apply_stops(tokenizer.decode(tokens),
+                                            len(tokens), max_tokens,
+                                            stop_strs)
+                body = ({"message": {"role": "assistant", "content": text}}
+                        if chat else {"text": text})
+                choices.append(dict(index=idx, finish_reason=finish,
+                                    logprobs=None, **body))
+        except BaseException:
+            for req in requests:
+                req.cancel()
+            raise
+        rid = (f"chatcmpl-{uuid.uuid4().hex[:24]}" if chat
+               else f"cmpl-{uuid.uuid4().hex[:24]}")
+        return Raw({
+            "id": rid,
+            "object": "chat.completion" if chat else "text_completion",
+            "created": int(time.time()),
+            "model": model_id, "choices": choices,
+            "usage": {"prompt_tokens": len(prompt_toks),
+                      "completion_tokens": total_out,
+                      "total_tokens": len(prompt_toks) + total_out},
+        })
 
     @app.get("/v1/models")
     def models(ctx):
@@ -116,6 +174,21 @@ def build_app(**kw) -> App:
             if not isinstance(prompt, str) or not prompt:
                 raise InvalidParam(["prompt"])
         max_tokens, temperature, stop_strs = _params(body)
+        try:
+            n_choices = int(body.get("n", 1))
+        except (TypeError, ValueError) as exc:
+            raise InvalidParam(["n"]) from exc
+        if not 1 <= n_choices <= max(1, engine.n_slots):
+            raise InvalidParam([f"n must be 1..{engine.n_slots}"])
+        if n_choices > 1:
+            if body.get("stream"):
+                raise InvalidParam(["n: streaming supports n=1"])
+            if temperature <= 0.0:
+                # greedy sampling is deterministic: n identical choices
+                # would be a silent lie, match OpenAI's temperature advice
+                raise InvalidParam(["n > 1 requires temperature > 0"])
+            return _multi_completion(ctx, chat, prompt, n_choices,
+                                     max_tokens, temperature, stop_strs)
         request, prompt_toks = _submit(prompt, max_tokens, temperature)
         created = int(time.time())
         rid = (f"chatcmpl-{uuid.uuid4().hex[:24]}" if chat
@@ -180,19 +253,12 @@ def build_app(**kw) -> App:
 
             return Stream(chunks(), sse=True, on_close=request.cancel)
 
-        from gofr_tpu.http.errors import RequestTimeout
-
         try:
             tokens = request.result(timeout_s=ctx.remaining())
         except TimeoutError as exc:
             raise RequestTimeout() from exc
-        text = tokenizer.decode(tokens)
-        finish = _finish_reason(len(tokens), max_tokens)
-        for s in stop_strs:  # string-level stop sequences
-            idx = text.find(s)
-            if idx >= 0:
-                text = text[:idx]
-                finish = "stop"
+        text, finish = _apply_stops(tokenizer.decode(tokens), len(tokens),
+                                    max_tokens, stop_strs)
         message_or_text = ({"message": {"role": "assistant", "content": text}}
                            if chat else {"text": text})
         return Raw({

@@ -363,3 +363,36 @@ def test_draining_engine_returns_503():
     finally:
         engine.stop()
         app.shutdown()
+
+
+def test_openai_server_n_choices():
+    module = _load("openai-server")
+    app = module.build_app(config=_cfg(TPU_PLATFORM="cpu",
+                                       MODEL_PRESET="debug", WARMUP="false",
+                                       REQUEST_TIMEOUT="60"))
+    app.start()
+    try:
+        port = app.http_port
+        status, body = _call(port, "/v1/completions", "POST",
+                             {"prompt": "pick", "max_tokens": 6,
+                              "temperature": 0.9, "n": 3})
+        assert status == 201
+        assert [c["index"] for c in body["choices"]] == [0, 1, 2]
+        # a choice may sample EOS early: <= bound, finish_reason sane
+        assert 3 <= body["usage"]["completion_tokens"] <= 18
+        assert all(c["finish_reason"] in ("stop", "length")
+                   for c in body["choices"])
+        # sampled choices must not all be identical
+        texts = [c["text"] for c in body["choices"]]
+        assert len(set(texts)) > 1
+        # greedy n>1 is rejected (it would return n identical choices)
+        status, _ = _call(port, "/v1/completions", "POST",
+                          {"prompt": "x", "max_tokens": 4, "n": 2,
+                           "temperature": 0})
+        assert status == 400
+        status, _ = _call(port, "/v1/completions", "POST",
+                          {"prompt": "x", "max_tokens": 4, "n": 2,
+                           "temperature": 0.9, "stream": True})
+        assert status == 400
+    finally:
+        app.shutdown()
