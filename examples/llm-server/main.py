@@ -176,11 +176,22 @@ def main() -> None:
 
     @app.get("/stats")
     def stats(ctx):
-        return {
+        out = {
             "active_slots": sum(1 for s in engine.slots if s.active),
             "queue_depth": engine._pending.qsize(),
             "compiled_programs": engine.executor.cache_size,
         }
+        if engine.speculative_tokens:
+            out["spec"] = {
+                "accept_ema": round(engine._spec_accept_ema, 3),
+                "cooloff_dispatches": engine._spec_cooloff,
+            }
+        allocator = getattr(engine, "allocator", None)
+        if allocator is not None:
+            out["pages"] = {"used": allocator.used_pages,
+                            "free": allocator.free_pages,
+                            "page_size": allocator.page_size}
+        return out
 
     app.run()
 
