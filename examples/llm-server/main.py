@@ -141,10 +141,16 @@ def main() -> None:
         temperature = float(body.get("temperature", 0.0))
         stream = bool(body.get("stream", True))
 
+        try:
+            # lower admits first; clamp so no client can outrank the range
+            priority = max(0, min(9, int(body.get("priority", 0))))
+        except (TypeError, ValueError) as exc:
+            raise InvalidParam(["priority"]) from exc
         request = engine.submit(
             tokenizer.encode(prompt), max_new_tokens=max_tokens,
             temperature=temperature, stop_tokens={tokenizer.EOS},
-            span=ctx.span)  # batch.id/slot correlation lands on this span
+            span=ctx.span,  # batch.id/slot correlation lands on this span
+            priority=priority)
 
         if not stream:
             from gofr_tpu.http.errors import RequestTimeout

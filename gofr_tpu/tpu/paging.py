@@ -193,10 +193,11 @@ class PagedLLMEngine(LLMEngine):
     # -- admission: page reservation ------------------------------------------
     def submit(self, prompt_tokens, max_new_tokens: int = 128,
                temperature: float = 0.0, stop_tokens=None,
-               span=None) -> GenerationRequest:
+               span=None, priority: int = 0) -> GenerationRequest:
         """Reject requests whose reservation could NEVER fit the pool:
-        deferring them would head-of-line-block every later request behind
-        an allocation that cannot succeed."""
+        parking them would permanently occupy the admission heap's head
+        for their priority class behind an allocation that cannot
+        succeed."""
         total = min(len(prompt_tokens) + max_new_tokens, self.max_seq_len)
         need = self.allocator.pages_for(total)
         usable = self.allocator.n_pages - 1
@@ -206,7 +207,7 @@ class PagedLLMEngine(LLMEngine):
                 f"{self.allocator.page_size}) but the pool has only {usable} "
                 f"usable pages; shrink max_new_tokens or grow n_pages")
         return super().submit(prompt_tokens, max_new_tokens, temperature,
-                              stop_tokens, span=span)
+                              stop_tokens, span=span, priority=priority)
 
     def _request_pages(self, request: GenerationRequest) -> int:
         total = min(len(request.prompt_tokens) + request.max_new_tokens,

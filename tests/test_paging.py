@@ -326,3 +326,38 @@ def test_paged_attention_int8_matches_reference():
     exact = paged_attention_reference(q, k, v, table, lens)
     np.testing.assert_allclose(np.asarray(out), np.asarray(exact),
                                rtol=0.15, atol=0.15)
+
+
+def test_paged_priority_no_head_of_line_inversion():
+    """A small high-priority request must admit while a big low-priority
+    request stays parked on page exhaustion — and the parked one still
+    completes once pages free (no starvation)."""
+    params = llama_init(CFG, seed=0)
+    # tiny pool: 1 garbage + 6 usable pages of 8 tokens
+    eng = PagedLLMEngine(params, CFG, page_size=8, n_pages=7, n_slots=2,
+                         max_seq_len=64, prefill_buckets=(8, 32),
+                         decode_block_size=2)
+    eng.start()
+    try:
+        # occupy most of the pool: 30 prompt + 10 new = 5 pages
+        hog = eng.submit(list(range(1, 31)), max_new_tokens=10,
+                         temperature=0.0)
+        deadline = time.time() + 60
+        while hog.admitted_at is None and time.time() < deadline:
+            time.sleep(0.005)
+        # big low-priority: needs 5 pages -> parks (1 free page)
+        big_low = eng.submit(list(range(1, 29)), max_new_tokens=10,
+                             temperature=0.0, priority=5)
+        time.sleep(0.3)
+        assert big_low.admitted_at is None, "should be parked on pages"
+        # small high-priority: needs 1 page -> must NOT wait behind big_low
+        small_high = eng.submit([7, 7], max_new_tokens=4, temperature=0.0,
+                                priority=0)
+        out = small_high.result(timeout_s=120)
+        assert len(out) == 4
+        assert big_low.admitted_at is None or \
+            small_high.admitted_at <= big_low.admitted_at
+        # and the parked request eventually runs to completion
+        assert len(big_low.result(timeout_s=120)) == 10
+    finally:
+        eng.stop()

@@ -542,3 +542,31 @@ def test_app_shutdown_hooks_run_lifo():
     app.start()
     app.shutdown()
     assert order == ["second", "first"]
+
+
+def test_priority_admission_order():
+    """A high-priority request queued behind low-priority ones is admitted
+    first once a slot frees; running generations are never preempted."""
+    from gofr_tpu.models.llama import LlamaConfig, llama_init
+    from gofr_tpu.tpu.engine import LLMEngine
+
+    cfg = LlamaConfig.debug()
+    eng = LLMEngine(llama_init(cfg, seed=0), cfg, n_slots=1, max_seq_len=64,
+                    prefill_buckets=(8,), decode_block_size=2)
+    eng.start()
+    try:
+        blocker = eng.submit([1, 2, 3], max_new_tokens=24, temperature=0.0)
+        deadline = time.time() + 60
+        while blocker.admitted_at is None and time.time() < deadline:
+            time.sleep(0.005)
+        low = [eng.submit([4 + i], max_new_tokens=2, temperature=0.0,
+                          priority=5) for i in range(4)]
+        high = eng.submit([9, 9], max_new_tokens=2, temperature=0.0,
+                          priority=0)
+        for r in [blocker, high] + low:
+            r.result(timeout_s=120)
+        assert high.admitted_at is not None
+        assert all(high.admitted_at <= r.admitted_at for r in low), \
+            "high-priority request did not jump the queue"
+    finally:
+        eng.stop()
