@@ -144,13 +144,15 @@ def main() -> None:
         try:
             # lower admits first; clamp so no client can outrank the range
             priority = max(0, min(9, int(body.get("priority", 0))))
+            # EOS is ignored until this floor is reached
+            min_tokens = max(0, int(body.get("min_tokens", 0) or 0))
         except (TypeError, ValueError) as exc:
-            raise InvalidParam(["priority"]) from exc
+            raise InvalidParam(["priority", "min_tokens"]) from exc
         request = engine.submit(
             tokenizer.encode(prompt), max_new_tokens=max_tokens,
             temperature=temperature, stop_tokens={tokenizer.EOS},
             span=ctx.span,  # batch.id/slot correlation lands on this span
-            priority=priority)
+            priority=priority, min_tokens=min_tokens)
 
         if not stream:
             from gofr_tpu.http.errors import RequestTimeout

@@ -75,12 +75,15 @@ _request_ids = itertools.count(1)
 class GenerationRequest:
     def __init__(self, prompt_tokens: Sequence[int], max_new_tokens: int = 128,
                  temperature: float = 0.0, stop_tokens: Optional[Set[int]] = None,
-                 span=None, priority: int = 0):
+                 span=None, priority: int = 0, min_tokens: int = 0):
         self.id = next(_request_ids)
         # admission priority: LOWER admits first; ties resolve FIFO by id.
         # Purely host-side — it reorders which queued request gets the next
         # free slot, never touching running generations
         self.priority = int(priority)
+        # stop_tokens are ignored until this many tokens have been emitted
+        # (host-side demux rule; the device never sees stop conditions)
+        self.min_tokens = max(0, int(min_tokens))
         self.prompt_tokens = list(prompt_tokens)
         self.max_new_tokens = max_new_tokens
         self.temperature = float(temperature)
@@ -104,6 +107,13 @@ class GenerationRequest:
 
     def cancel(self) -> None:
         self.cancelled.set()
+
+    def hit_stop(self, token: int) -> bool:
+        """True when `token` ends the generation: a stop token counts only
+        once min_tokens have been emitted (generated already includes this
+        token at every call site)."""
+        return (token in self.stop_tokens
+                and self.generated >= self.min_tokens)
 
     def stream(self, timeout_s: Optional[float] = None) -> Iterator[int]:
         """Yield generated token ids until the engine signals completion.
@@ -516,9 +526,11 @@ class LLMEngine:
     def submit(self, prompt_tokens: Sequence[int], max_new_tokens: int = 128,
                temperature: float = 0.0,
                stop_tokens: Optional[Set[int]] = None,
-               span=None, priority: int = 0) -> GenerationRequest:
+               span=None, priority: int = 0,
+               min_tokens: int = 0) -> GenerationRequest:
         """priority: LOWER admits first when slots are contended (ties stay
-        FIFO); running generations are never preempted."""
+        FIFO); running generations are never preempted. min_tokens: stop
+        tokens are ignored until this many tokens have been emitted."""
         if self._stop.is_set():
             raise RuntimeError("engine is stopped")
         if self._draining:
@@ -530,7 +542,8 @@ class LLMEngine:
             raise ValueError(f"prompt of {len(prompt_tokens)} tokens exceeds the "
                              f"admission limit ({limit})")
         request = GenerationRequest(prompt_tokens, max_new_tokens, temperature,
-                                    stop_tokens, span=span, priority=priority)
+                                    stop_tokens, span=span, priority=priority,
+                                    min_tokens=min_tokens)
         if self.tracer is not None:
             request.gen_span = self.tracer.start_span("tpu.generate",
                                                       parent=span)
@@ -1547,7 +1560,7 @@ class LLMEngine:
                 if self.speculative_tokens:
                     slot.history = list(request.prompt_tokens) + [token]
                 self._emit(request, token)
-                if (token in request.stop_tokens or slot.remaining <= 0
+                if (request.hit_stop(token) or slot.remaining <= 0
                         or request.cancelled.is_set()):
                     self._finish_slot(slot)
             return
@@ -1587,7 +1600,7 @@ class LLMEngine:
                         slot.history.append(token)
                     self._emit(request, token)
                     emitted += 1
-                    if (token in request.stop_tokens or slot.remaining <= 0
+                    if (request.hit_stop(token) or slot.remaining <= 0
                             or request.cancelled.is_set()
                             or slot.length >= self.max_seq_len - 1):
                         self._finish_slot(slot)
@@ -1642,7 +1655,7 @@ class LLMEngine:
                     slot.history.append(token)
                 self._emit(request, token)
                 emitted += 1
-                if (token in request.stop_tokens or slot.remaining <= 0
+                if (request.hit_stop(token) or slot.remaining <= 0
                         or request.cancelled.is_set()
                         or slot.length >= self.max_seq_len - 1):
                     self._finish_slot(slot)

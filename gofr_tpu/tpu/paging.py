@@ -193,7 +193,8 @@ class PagedLLMEngine(LLMEngine):
     # -- admission: page reservation ------------------------------------------
     def submit(self, prompt_tokens, max_new_tokens: int = 128,
                temperature: float = 0.0, stop_tokens=None,
-               span=None, priority: int = 0) -> GenerationRequest:
+               span=None, priority: int = 0,
+               min_tokens: int = 0) -> GenerationRequest:
         """Reject requests whose reservation could NEVER fit the pool:
         parking them would permanently occupy the admission heap's head
         for their priority class behind an allocation that cannot
@@ -207,7 +208,8 @@ class PagedLLMEngine(LLMEngine):
                 f"{self.allocator.page_size}) but the pool has only {usable} "
                 f"usable pages; shrink max_new_tokens or grow n_pages")
         return super().submit(prompt_tokens, max_new_tokens, temperature,
-                              stop_tokens, span=span, priority=priority)
+                              stop_tokens, span=span, priority=priority,
+                              min_tokens=min_tokens)
 
     def _request_pages(self, request: GenerationRequest) -> int:
         total = min(len(request.prompt_tokens) + request.max_new_tokens,

@@ -570,3 +570,32 @@ def test_priority_admission_order():
             "high-priority request did not jump the queue"
     finally:
         eng.stop()
+
+
+def test_min_tokens_suppresses_early_stop():
+    """stop_tokens are ignored until min_tokens have been emitted; without
+    the floor the same stop set ends generation earlier."""
+    from gofr_tpu.models.llama import LlamaConfig, llama_init
+    from gofr_tpu.tpu.engine import LLMEngine
+
+    cfg = LlamaConfig.debug()
+    eng = LLMEngine(llama_init(cfg, seed=0), cfg, n_slots=2, max_seq_len=64,
+                    prefill_buckets=(8,), decode_block_size=4)
+    eng.start()
+    try:
+        prompt = [3, 1, 4]
+        free = eng.generate(prompt, max_new_tokens=20, temperature=0.0)
+        assert len(free) == 20
+        # every token the model would emit becomes a stop token: without a
+        # floor the request ends at the first one...
+        stops = set(free)
+        early = eng.generate(prompt, max_new_tokens=20, temperature=0.0,
+                             stop_tokens=stops)
+        assert len(early) == 1
+        # ...with min_tokens=7 exactly 7 are forced out
+        floored = eng.generate(prompt, max_new_tokens=20, temperature=0.0,
+                               stop_tokens=stops, min_tokens=7)
+        assert len(floored) == 7
+        assert floored == free[:7]
+    finally:
+        eng.stop()
