@@ -69,16 +69,22 @@ def build_app(**kw) -> App:
         try:
             max_tokens = int(body.get("max_tokens", 16))
             temperature = float(body.get("temperature", 1.0))
+            # extension (vLLM-style): stop conditions suppressed until
+            # this floor of emitted tokens
+            min_tokens = int(body.get("min_tokens", 0))
         except (TypeError, ValueError) as exc:
-            raise InvalidParam(["max_tokens", "temperature"]) from exc
+            raise InvalidParam(["max_tokens", "temperature",
+                               "min_tokens"]) from exc
         if max_tokens < 1:
             raise InvalidParam(["max_tokens"])
+        if not 0 <= min_tokens <= max_tokens:
+            raise InvalidParam(["min_tokens must be 0..max_tokens"])
         stop = body.get("stop") or []
         if isinstance(stop, str):
             stop = [stop]
         if not all(isinstance(s, str) for s in stop):
             raise InvalidParam(["stop"])
-        return max_tokens, temperature, stop
+        return max_tokens, temperature, stop, min_tokens
 
     def _encode_checked(prompt: str):
         prompt_tokens = tokenizer.encode(prompt)
@@ -90,30 +96,42 @@ def build_app(**kw) -> App:
                  f"context limit ({engine.admission_limit})"])
         return prompt_tokens
 
-    def _submit_tokens(prompt_tokens, max_tokens: int, temperature: float):
+    def _submit_tokens(prompt_tokens, max_tokens: int, temperature: float,
+                       min_tokens: int = 0):
         return engine.submit(prompt_tokens, max_new_tokens=max_tokens,
                              temperature=temperature,
-                             stop_tokens={tokenizer.EOS})
+                             stop_tokens={tokenizer.EOS},
+                             min_tokens=min_tokens)
 
-    def _submit(prompt: str, max_tokens: int, temperature: float):
+    def _submit(prompt: str, max_tokens: int, temperature: float,
+                min_tokens: int = 0):
         prompt_tokens = _encode_checked(prompt)
-        return _submit_tokens(prompt_tokens, max_tokens, temperature), \
-            prompt_tokens
+        return _submit_tokens(prompt_tokens, max_tokens, temperature,
+                              min_tokens), prompt_tokens
 
     def _finish_reason(n_emitted: int, max_tokens: int) -> str:
         return "length" if n_emitted >= max_tokens else "stop"
 
-    def _apply_stops(text: str, n_tokens: int, max_tokens: int, stop_strs):
+    def _apply_stops(text: str, n_tokens: int, max_tokens: int, stop_strs,
+                     floor_chars: int = 0):
+        """Stop strings only match at offsets >= floor_chars — the text of
+        the first min_tokens tokens is immune, mirroring the engine's
+        min_tokens rule for stop token ids."""
         finish = _finish_reason(n_tokens, max_tokens)
         for s in stop_strs:
-            idx = text.find(s)
+            idx = text.find(s, floor_chars)
             if idx >= 0:
                 text = text[:idx]
                 finish = "stop"
         return text, finish
 
+    def _floor_chars(tokens, min_tokens: int) -> int:
+        if min_tokens <= 0 or not tokens:
+            return 0
+        return len(tokenizer.decode(tokens[:min_tokens]))
+
     def _multi_completion(ctx, chat, prompt, n_choices, max_tokens,
-                          temperature, stop_strs):
+                          temperature, stop_strs, min_tokens):
         """n > 1: fan the prompt out as n engine requests (they batch into
         the same continuous-batching slots) and collect n choices. Encode
         once; ANY failure cancels every sibling so abandoned requests
@@ -124,7 +142,7 @@ def build_app(**kw) -> App:
         try:
             for _ in range(n_choices):
                 requests.append(_submit_tokens(prompt_toks, max_tokens,
-                                               temperature))
+                                               temperature, min_tokens))
             for idx, req in enumerate(requests):
                 try:
                     tokens = req.result(timeout_s=ctx.remaining())
@@ -133,7 +151,8 @@ def build_app(**kw) -> App:
                 total_out += len(tokens)
                 text, finish = _apply_stops(tokenizer.decode(tokens),
                                             len(tokens), max_tokens,
-                                            stop_strs)
+                                            stop_strs,
+                                            _floor_chars(tokens, min_tokens))
                 body = ({"message": {"role": "assistant", "content": text}}
                         if chat else {"text": text})
                 choices.append(dict(index=idx, finish_reason=finish,
@@ -173,7 +192,7 @@ def build_app(**kw) -> App:
             prompt = body.get("prompt")
             if not isinstance(prompt, str) or not prompt:
                 raise InvalidParam(["prompt"])
-        max_tokens, temperature, stop_strs = _params(body)
+        max_tokens, temperature, stop_strs, min_tokens = _params(body)
         try:
             n_choices = int(body.get("n", 1))
         except (TypeError, ValueError) as exc:
@@ -188,8 +207,10 @@ def build_app(**kw) -> App:
                 # would be a silent lie, match OpenAI's temperature advice
                 raise InvalidParam(["n > 1 requires temperature > 0"])
             return _multi_completion(ctx, chat, prompt, n_choices,
-                                     max_tokens, temperature, stop_strs)
-        request, prompt_toks = _submit(prompt, max_tokens, temperature)
+                                     max_tokens, temperature, stop_strs,
+                                     min_tokens)
+        request, prompt_toks = _submit(prompt, max_tokens, temperature,
+                                       min_tokens)
         created = int(time.time())
         rid = (f"chatcmpl-{uuid.uuid4().hex[:24]}" if chat
                else f"cmpl-{uuid.uuid4().hex[:24]}")
@@ -222,12 +243,24 @@ def build_app(**kw) -> App:
                 # the last len(longest_stop)-1 chars until more text lands
                 hold = max((len(s) for s in stop_strs), default=0) - 1
                 acc, sent, stopped = "", 0, False
+                floor_chars = None if min_tokens else 0
                 for token in request.stream():
                     count += 1
                     acc += decoder.push(token)
-                    cut = min((idx for idx in (acc.find(s, max(0, sent - hold))
-                                               for s in stop_strs)
-                               if idx >= 0), default=-1)
+                    if floor_chars is None:
+                        if count < min_tokens:
+                            continue_scan = False
+                        else:
+                            floor_chars = len(acc)  # first min_tokens' text
+                            continue_scan = True
+                    else:
+                        continue_scan = True
+                    cut = min((idx for idx in
+                               (acc.find(s, max(floor_chars or 0,
+                                                sent - hold))
+                                for s in stop_strs)
+                               if idx >= 0), default=-1) if continue_scan \
+                        else -1
                     if cut >= 0:
                         if cut > sent:
                             yield _chunk(text=acc[sent:cut])
@@ -240,8 +273,10 @@ def build_app(**kw) -> App:
                         sent = safe
                 if not stopped:
                     acc += decoder.flush()
-                    cut = min((idx for idx in (acc.find(s, max(0, sent - hold))
-                                               for s in stop_strs)
+                    cut = min((idx for idx in
+                               (acc.find(s, max(floor_chars or 0,
+                                                sent - hold))
+                                for s in stop_strs)
                                if idx >= 0), default=-1)
                     end = cut if cut >= 0 else len(acc)
                     stopped = cut >= 0
@@ -258,7 +293,8 @@ def build_app(**kw) -> App:
         except TimeoutError as exc:
             raise RequestTimeout() from exc
         text, finish = _apply_stops(tokenizer.decode(tokens), len(tokens),
-                                    max_tokens, stop_strs)
+                                    max_tokens, stop_strs,
+                                    _floor_chars(tokens, min_tokens))
         message_or_text = ({"message": {"role": "assistant", "content": text}}
                            if chat else {"text": text})
         return Raw({

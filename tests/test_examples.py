@@ -396,3 +396,45 @@ def test_openai_server_n_choices():
         assert status == 400
     finally:
         app.shutdown()
+
+
+def test_openai_server_min_tokens_gates_stop_strings():
+    module = _load("openai-server")
+    app = module.build_app(config=_cfg(TPU_PLATFORM="cpu",
+                                       MODEL_PRESET="debug", WARMUP="false",
+                                       REQUEST_TIMEOUT="60"))
+    app.start()
+    try:
+        port = app.http_port
+        status, body = _call(port, "/v1/completions", "POST",
+                             {"prompt": "mmm", "max_tokens": 12,
+                              "temperature": 0})
+        assert status == 201
+        full = body["choices"][0]["text"]
+        assert len(full) > 4
+        early_stop = full[1:3]   # occurs early in the text
+        # without a floor, the stop truncates early
+        status, body = _call(port, "/v1/completions", "POST",
+                             {"prompt": "mmm", "max_tokens": 12,
+                              "temperature": 0, "stop": early_stop})
+        assert status == 201
+        truncated = body["choices"][0]["text"]
+        assert len(truncated) < len(full)
+        # with min_tokens=12 the early occurrence is immune: full length
+        status, body = _call(port, "/v1/completions", "POST",
+                             {"prompt": "mmm", "max_tokens": 12,
+                              "temperature": 0, "stop": early_stop,
+                              "min_tokens": 12})
+        assert status == 201
+        assert len(body["choices"][0]["text"]) >= len(full) - 1
+        assert body["choices"][0]["finish_reason"] == "length"
+        # validation: min > max and bad types are 400s
+        status, _ = _call(port, "/v1/completions", "POST",
+                          {"prompt": "x", "max_tokens": 4, "min_tokens": 9})
+        assert status == 400
+        status, _ = _call(port, "/v1/completions", "POST",
+                          {"prompt": "x", "max_tokens": 4,
+                           "min_tokens": []})
+        assert status == 400
+    finally:
+        app.shutdown()
