@@ -73,7 +73,21 @@ def build_engine(app: App) -> LLMEngine:
                          f"tokenizer ({tokenizer.vocab_size})")
     app.logger.infof("initialising %s (%.2fB params)...", preset,
                      cfg.param_count() / 1e9)
-    params = llama_init(cfg, seed=0)
+    # WEIGHT_DTYPE=int8 stores weights as per-output-channel int8 — halves
+    # weight HBM (llama3-8b: ~15 GiB bf16 -> ~8 GiB, the difference between
+    # not fitting and serving on one 16 GiB v5e chip) AND halves the
+    # per-step weight read. Init goes straight to int8 leaf-by-leaf so the
+    # float tree never has to fit (models.llama.llama_init_quantized).
+    weight_dtype = app.config.get_or_default("WEIGHT_DTYPE", "") or None
+    if weight_dtype not in (None, "int8"):
+        raise ValueError(f"WEIGHT_DTYPE must be int8 or unset, "
+                         f"got {weight_dtype!r}")
+    if weight_dtype == "int8":
+        from gofr_tpu.models.llama import llama_init_quantized
+
+        params = llama_init_quantized(cfg, seed=0)
+    else:
+        params = llama_init(cfg, seed=0)
     # TP_SHARDS>1 serves tensor-parallel over the chip slice (BASELINE
     # config 5: Llama-70B TP=8 on v5e-8) — same engine, sharded mesh
     tp = app.config.get_int("TP_SHARDS", 1)
@@ -90,10 +104,20 @@ def build_engine(app: App) -> LLMEngine:
         n_pages = app.config.get_int("N_PAGES", 0)
         if n_pages:
             paged_kw["n_pages"] = n_pages
+    # HBM capacity plan: clamp (MAX_BATCH, MAX_SEQ_LEN) to the device budget
+    # before boot instead of discovering RESOURCE_EXHAUSTED mid-serve.
+    # Auto-detected from the device (0 on CPU backends = no plan);
+    # HBM_BUDGET_BYTES overrides for testing, -1 disables the plan.
+    from gofr_tpu.tpu.capacity import device_budget_bytes
+
+    budget_cfg = app.config.get_int("HBM_BUDGET_BYTES", 0)
+    budget = (0 if budget_cfg < 0
+              else budget_cfg or device_budget_bytes(tpu))
     engine = engine_cls(
         params, cfg,
         n_slots=app.config.get_int("MAX_BATCH", 8),
         max_seq_len=app.config.get_int("MAX_SEQ_LEN", 1024),
+        budget_bytes=budget or None,
         prefill_buckets=tuple(int(b) for b in app.config.get_or_default(
             "PREFILL_BUCKETS", "16,32,64,128,256").split(",")),
         executor=Executor(tpu, cache_dir=app.config.get_or_default(

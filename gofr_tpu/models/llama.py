@@ -182,6 +182,185 @@ import jax  # noqa: E402  (after dataclass defs so module import stays light)
 import jax.numpy as jnp  # noqa: E402
 
 
+# ---------------------------------------------------------------------------
+# INT8 weight quantization (weight-only storage, W8A8-dynamic compute)
+#
+# The north-star model (Llama-3-8B, BASELINE.md config 4) is ~15 GiB in bf16
+# — it does not fit one 16 GiB v5e chip at all. Per-output-channel int8
+# weights halve that to ~8 GiB AND halve the per-step weight HBM read, which
+# is the other half of the decode bandwidth bound next to the KV cache.
+#
+# Design (TPU-first, not a dequant-copy):
+#   - storage: W -> int8 with per-output-channel scales s = absmax/127.
+#     A "dequantize then matmul" lowering would materialize a bf16 copy of
+#     the weight as a fusion output every step — MORE HBM traffic than bf16
+#     weights. Instead activations quantize dynamically per row (absmax
+#     over the contraction dim) and the dot runs int8 x int8 -> int32 on
+#     the MXU natively (2x bf16 peak on v5e), reading the int8 weights
+#     straight from HBM. Output rescales by (row_scale ⊗ channel_scale).
+#   - mode selection: the weights' dtype IS the switch. Every matmul site
+#     goes through _mm/_embed/_head, which branch on `w.dtype == int8` at
+#     trace time — no config plumbing, and a bf16 tree serves identically
+#     to before.
+#   - norms stay float (tiny); embedding gathers int8 rows and rescales
+#     per token (a [B, T, D] elementwise — negligible).
+# ---------------------------------------------------------------------------
+
+
+def _q_matmul(x, w8, s, out_dtype=None):
+    """Weight-only int8 matmul with dynamic per-row activation quantization.
+
+    x: [..., Din] float; w8: [Din, Dout] int8; s: [Dout] f32 per-output-
+    channel weight scales. Returns [..., Dout] in out_dtype (default
+    x.dtype). Under tensor parallelism the row absmax over a tp-sharded
+    contraction dim lowers to a tiny [rows, 1] collective max — XLA
+    propagates the sharding; no manual collectives here.
+    """
+    xf = x.astype(jnp.float32)
+    ax = jnp.maximum(jnp.max(jnp.abs(xf), axis=-1, keepdims=True), 1e-12)
+    x8 = jnp.round(xf * (127.0 / ax)).astype(jnp.int8)
+    acc = jax.lax.dot_general(
+        x8, w8, (((x8.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    out = acc.astype(jnp.float32) * (ax / 127.0) * s
+    return out.astype(out_dtype or x.dtype)
+
+
+def _mm(x, tree, name):
+    """x @ tree[name], through the int8 path when the weight is quantized."""
+    w = tree[name]
+    if w.dtype == jnp.int8:
+        return _q_matmul(x, w, tree[name + "_s"])
+    return x @ w
+
+
+def _embed(params, cfg: LlamaConfig, tokens):
+    """Token embedding gather; dequantizes per-row when tok_emb is int8."""
+    e = params["tok_emb"][tokens]
+    if e.dtype == jnp.int8:
+        scale = params["tok_emb_s"][tokens]          # [...,] f32 per row
+        return (e.astype(jnp.float32) * scale[..., None]).astype(
+            _np_dtype(cfg.dtype))
+    return e
+
+
+def _head(x, params):
+    """lm_head projection to float32 logits (int8-aware)."""
+    w = params["lm_head"]
+    if w.dtype == jnp.int8:
+        return _q_matmul(x, w, params["lm_head_s"], out_dtype=jnp.float32)
+    return (x @ w).astype(jnp.float32)
+
+
+def _quantize_leaf(w, axis: int):
+    """Symmetric per-channel int8: returns (w8, scale) with scale shaped as
+    w minus `axis` (the contraction dim)."""
+    wf = w.astype(jnp.float32)
+    s = jnp.maximum(jnp.max(jnp.abs(wf), axis=axis), 1e-12) / 127.0
+    w8 = jnp.clip(jnp.round(wf / jnp.expand_dims(s, axis)), -127, 127
+                  ).astype(jnp.int8)
+    return w8, s
+
+
+# weight name -> contraction axis reduced away by its scale. Layer weights
+# are stacked [L, in, out]; tok_emb [V, D] scales per row (gather dim);
+# lm_head [D, V] per output channel. Norm vectors stay float.
+_QUANT_AXES = {"wq": -2, "wk": -2, "wv": -2, "wo": -2,
+               "w_gate": -2, "w_up": -2, "w_down": -2}
+
+
+def quantize_weights(params: Dict[str, Any]) -> Dict[str, Any]:
+    """Quantize a bf16/f32 params tree to int8 storage, leaf by leaf.
+
+    CONSUMES the input tree: each float leaf is popped out of the nested
+    dicts as its int8 twin is built, so (given the caller holds no other
+    references to the leaves) peak HBM is the float tree plus ONE leaf's
+    int8 copy — not two full trees. For models whose float tree already
+    crowds the chip, use llama_init_quantized, which never materializes
+    the float tree at all.
+    """
+    q = jax.jit(_quantize_leaf, static_argnums=1)
+
+    out_layers = {}
+    layers = params["layers"]
+    for name in list(_QUANT_AXES):
+        w8, s = q(layers.pop(name), _QUANT_AXES[name])
+        jax.block_until_ready(w8)
+        out_layers[name] = w8
+        out_layers[name + "_s"] = s
+    out_layers["attn_norm"] = layers["attn_norm"]
+    out_layers["ffn_norm"] = layers["ffn_norm"]
+    tok8, tok_s = q(params.pop("tok_emb"), -1)
+    jax.block_until_ready(tok8)   # embed-sized float temps must not overlap
+    head8, head_s = q(params.pop("lm_head"), -2)
+    return {
+        "tok_emb": tok8, "tok_emb_s": tok_s,
+        "layers": out_layers,
+        "final_norm": params["final_norm"],
+        "lm_head": head8, "lm_head_s": head_s,
+    }
+
+
+def llama_init_quantized(cfg: LlamaConfig, seed: int = 0) -> Dict[str, Any]:
+    """Random-init DIRECTLY to int8 storage, one leaf at a time.
+
+    Generates each float leaf inside a jit whose only outputs are the int8
+    weight and its scales, so the float tensor is a program temporary —
+    peak HBM is the accumulated int8 tree plus one float leaf (~13 GiB for
+    8B vs ~17 GiB for init-then-quantize, which OOMs a 16 GiB chip).
+    Numerically identical to quantize_weights(llama_init(cfg, seed)).
+    """
+    dtype = _np_dtype(cfg.dtype)
+    key = jax.random.PRNGKey(seed)
+    keys = jax.random.split(key, 8)
+    L, D, H, Hkv, dh, F, V = (cfg.n_layers, cfg.dim, cfg.n_heads,
+                              cfg.n_kv_heads, cfg.head_dim, cfg.ffn_dim,
+                              cfg.vocab_size)
+
+    import functools
+
+    @functools.partial(jax.jit, static_argnums=(1, 2, 3))
+    def gen_q(k, shape, fan_in, axis):
+        w = (jax.random.normal(k, shape, dtype=jnp.float32)
+             * (1.0 / math.sqrt(fan_in))).astype(dtype)
+        return _quantize_leaf(w, axis)
+
+    # (key, shape, fan_in, scale axis) — mirrors llama_init's spec table
+    spec = {
+        "wq": (keys[1], (L, D, H * dh), D, -2),
+        "wk": (keys[2], (L, D, Hkv * dh), D, -2),
+        "wv": (keys[3], (L, D, Hkv * dh), D, -2),
+        "wo": (keys[4], (L, H * dh, D), H * dh, -2),
+        "w_gate": (keys[5], (L, D, F), D, -2),
+        "w_up": (keys[6], (L, D, F), D, -2),
+        "w_down": (keys[7], (L, F, D), F, -2),
+    }
+    layers: Dict[str, Any] = {}
+    for name, (k, shape, fan, axis) in spec.items():
+        w8, s = gen_q(k, shape, fan, axis)
+        jax.block_until_ready(w8)    # keep at most one float temp live
+        layers[name] = w8
+        layers[name + "_s"] = s
+    layers["attn_norm"] = jnp.ones((L, D), dtype=dtype)
+    layers["ffn_norm"] = jnp.ones((L, D), dtype=dtype)
+    tok8, tok_s = gen_q(keys[0], (V, D), D, -1)
+    jax.block_until_ready(tok8)   # embed-sized float temps must not overlap
+    head8, head_s = gen_q(keys[0], (D, V), D, -2)
+    return {
+        "tok_emb": tok8, "tok_emb_s": tok_s,
+        "layers": layers,
+        "final_norm": jnp.ones((D,), dtype=dtype),
+        "lm_head": head8, "lm_head_s": head_s,
+    }
+
+
+def params_nbytes(params) -> int:
+    """Actual HBM bytes of a params tree (int8-aware, unlike the analytic
+    cfg-based estimate in tpu/capacity.params_bytes)."""
+    return sum(leaf.nbytes for leaf in jax.tree_util.tree_leaves(params)
+               if hasattr(leaf, "nbytes"))
+
+
 def _attention_block(x, layer, k_cache_l, v_cache_l, positions, cfg: LlamaConfig):
     """One attention sublayer with cache write + masked read.
 
@@ -203,9 +382,9 @@ def _attention_block(x, layer, k_cache_l, v_cache_l, positions, cfg: LlamaConfig
     H, Hkv, dh, G = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim, cfg.q_per_kv
 
     normed = rms_norm(x, layer["attn_norm"], cfg.rms_eps)
-    q = (normed @ layer["wq"]).reshape(B, T, H, dh)
-    k = (normed @ layer["wk"]).reshape(B, T, Hkv, dh)
-    v = (normed @ layer["wv"]).reshape(B, T, Hkv, dh)
+    q = _mm(normed, layer, "wq").reshape(B, T, H, dh)
+    k = _mm(normed, layer, "wk").reshape(B, T, Hkv, dh)
+    v = _mm(normed, layer, "wv").reshape(B, T, Hkv, dh)
     q = rope(q, positions, cfg.rope_theta)
     k = rope(k, positions, cfg.rope_theta)
 
@@ -220,7 +399,7 @@ def _attention_block(x, layer, k_cache_l, v_cache_l, positions, cfg: LlamaConfig
         from ..ops.flash_attention import flash_attention
 
         attn = flash_attention(q, k, v, True)  # [B, T, H, dh]
-        out = attn.reshape(B, T, H * dh) @ layer["wo"]
+        out = _mm(attn.reshape(B, T, H * dh), layer, "wo")
         return out, k_cache_l, v_cache_l
 
     if T == 1 and cfg.decode_attn == "kernel":
@@ -230,7 +409,7 @@ def _attention_block(x, layer, k_cache_l, v_cache_l, positions, cfg: LlamaConfig
         # window is [0, positions] inclusive — lengths = positions + 1
         attn = decode_attention(q[:, 0], k_cache_l, v_cache_l,
                                 positions[:, 0] + 1)        # [B, H, dh]
-        out = attn.reshape(B, 1, H * dh) @ layer["wo"]
+        out = _mm(attn.reshape(B, 1, H * dh), layer, "wo")
         return out, k_cache_l, v_cache_l
 
     # GQA attention over the cache: q grouped [B, T, Hkv, G, dh].
@@ -248,15 +427,15 @@ def _attention_block(x, layer, k_cache_l, v_cache_l, positions, cfg: LlamaConfig
     out = jnp.einsum("bhgts,bhds->bthgd", probs.astype(v_cache_l.dtype),
                      v_cache_l,
                      preferred_element_type=jnp.float32).astype(x.dtype)
-    out = out.reshape(B, T, H * dh) @ layer["wo"]
+    out = _mm(out.reshape(B, T, H * dh), layer, "wo")
     return out, k_cache_l, v_cache_l
 
 
 def _ffn_block(x, layer, cfg: LlamaConfig):
     normed = rms_norm(x, layer["ffn_norm"], cfg.rms_eps)
-    gate = jax.nn.silu(normed @ layer["w_gate"])
-    up = normed @ layer["w_up"]
-    return (gate * up) @ layer["w_down"]
+    gate = jax.nn.silu(_mm(normed, layer, "w_gate"))
+    up = _mm(normed, layer, "w_up")
+    return _mm(gate * up, layer, "w_down")
 
 
 def llama_forward_hidden(params, cfg: LlamaConfig, tokens, positions, k_cache,
@@ -273,7 +452,7 @@ def llama_forward_hidden(params, cfg: LlamaConfig, tokens, positions, k_cache,
     [B, T, V] float32 logits — at Llama-3 vocab (128256) the full-logits
     buffer is GBs per fused admission and the dominant prefill FLOP waste.
     """
-    x = params["tok_emb"][tokens]
+    x = _embed(params, cfg, tokens)
 
     def body(x, scan_in):
         layer, k_l, v_l = scan_in
@@ -297,7 +476,7 @@ def llama_forward(params, cfg: LlamaConfig, tokens, positions, k_cache, v_cache)
     """
     x, k_cache, v_cache = llama_forward_hidden(params, cfg, tokens, positions,
                                                k_cache, v_cache)
-    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    logits = _head(x, params)
     return logits, k_cache, v_cache
 
 
@@ -316,7 +495,7 @@ def llama_prefill_last(params, cfg: LlamaConfig, tokens, positions, lengths,
         params, cfg, tokens, positions, k_cache, v_cache)
     B = hidden.shape[0]
     last = hidden[jnp.arange(B), lengths - 1]  # [B, D]
-    logits = (last @ params["lm_head"]).astype(jnp.float32)
+    logits = _head(last, params)
     return logits, k_cache, v_cache
 
 
@@ -372,7 +551,7 @@ def llama_decode_step_unrolled(params, cfg: LlamaConfig, tokens, positions,
     purely so XLA never slices a stacked cache in the hot loop (see
     init_kv_cache_layers).
     """
-    x = params["tok_emb"][tokens][:, None]                 # [B, 1, D]
+    x = _embed(params, cfg, tokens)[:, None]               # [B, 1, D]
     pos_grid = positions[:, None]
     k_out, v_out = [], []
     for l in range(cfg.n_layers):
@@ -384,7 +563,7 @@ def llama_decode_step_unrolled(params, cfg: LlamaConfig, tokens, positions,
         k_out.append(k_l)
         v_out.append(v_l)
     x = rms_norm(x, params["final_norm"], cfg.rms_eps)
-    logits = (x[:, 0] @ params["lm_head"]).astype(jnp.float32)
+    logits = _head(x[:, 0], params)
     return logits, tuple(k_out), tuple(v_out)
 
 
@@ -422,7 +601,7 @@ def llama_decode_step_unrolled_q8(params, cfg: LlamaConfig, tokens, positions,
 
     B = tokens.shape[0]
     H, Hkv, dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
-    x = params["tok_emb"][tokens][:, None]                 # [B, 1, D]
+    x = _embed(params, cfg, tokens)[:, None]               # [B, 1, D]
     pos_grid = positions[:, None]
     batch_idx = jnp.arange(B)
     k_out, v_out = list(k_layers), list(v_layers)
@@ -430,9 +609,9 @@ def llama_decode_step_unrolled_q8(params, cfg: LlamaConfig, tokens, positions,
     for l in range(cfg.n_layers):
         layer = jax.tree_util.tree_map(lambda w: w[l], params["layers"])
         normed = rms_norm(x, layer["attn_norm"], cfg.rms_eps)
-        q = (normed @ layer["wq"]).reshape(B, 1, H, dh)
-        k = (normed @ layer["wk"]).reshape(B, 1, Hkv, dh)
-        v = (normed @ layer["wv"]).reshape(B, 1, Hkv, dh)
+        q = _mm(normed, layer, "wq").reshape(B, 1, H, dh)
+        k = _mm(normed, layer, "wk").reshape(B, 1, Hkv, dh)
+        v = _mm(normed, layer, "wv").reshape(B, 1, Hkv, dh)
         q = rope(q, pos_grid, cfg.rope_theta)
         k = rope(k, pos_grid, cfg.rope_theta)
         k8, ks = quantize_kv(k[:, 0], axis=-1)             # [B,Hkv,dh], [B,Hkv]
@@ -443,10 +622,10 @@ def llama_decode_step_unrolled_q8(params, cfg: LlamaConfig, tokens, positions,
         vs_out[l] = vs_out[l].at[batch_idx, :, positions].set(vs)
         attn = decode_attention(q[:, 0], k_out[l], v_out[l], positions + 1,
                                 ks_out[l], vs_out[l])      # [B, H, dh]
-        x = x + attn.reshape(B, 1, H * dh) @ layer["wo"]
+        x = x + _mm(attn.reshape(B, 1, H * dh), layer, "wo")
         x = x + _ffn_block(x, layer, cfg)
     x = rms_norm(x, params["final_norm"], cfg.rms_eps)
-    logits = (x[:, 0] @ params["lm_head"]).astype(jnp.float32)
+    logits = _head(x[:, 0], params)
     return (logits, tuple(k_out), tuple(v_out), tuple(ks_out),
             tuple(vs_out))
 
@@ -467,7 +646,7 @@ def llama_decode_step_inplace(params, cfg: LlamaConfig, tokens, positions,
     tokens: [B]; positions: [B]. Returns (logits [B, V] f32, k, v).
     """
     B = tokens.shape[0]
-    x = params["tok_emb"][tokens][:, None]                 # [B, 1, D]
+    x = _embed(params, cfg, tokens)[:, None]               # [B, 1, D]
     pos_grid = positions[:, None]
 
     def layer_body(l, state):
@@ -485,7 +664,7 @@ def llama_decode_step_inplace(params, cfg: LlamaConfig, tokens, positions,
     x, k_cache, v_cache = jax.lax.fori_loop(
         0, cfg.n_layers, layer_body, (x, k_cache, v_cache))
     x = rms_norm(x, params["final_norm"], cfg.rms_eps)
-    logits = (x[:, 0] @ params["lm_head"]).astype(jnp.float32)
+    logits = _head(x[:, 0], params)
     return logits, k_cache, v_cache
 
 
@@ -514,7 +693,7 @@ def llama_prefill_chunk(params, cfg: LlamaConfig, tokens, positions,
     """
     k_out = list(k_layers)
     v_out = list(v_layers)
-    x = params["tok_emb"][tokens]                          # [K, C, D]
+    x = _embed(params, cfg, tokens)                        # [K, C, D]
     for l in range(cfg.n_layers):
         layer = jax.tree_util.tree_map(lambda w: w[l], params["layers"])
         k_rows = k_out[l][slots]                           # [K, Hkv, dh, S]
@@ -530,7 +709,7 @@ def llama_prefill_chunk(params, cfg: LlamaConfig, tokens, positions,
     x = rms_norm(x, params["final_norm"], cfg.rms_eps)
     K = x.shape[0]
     last = x[jnp.arange(K), project_last]                  # [K, D]
-    logits = (last @ params["lm_head"]).astype(jnp.float32)
+    logits = _head(last, params)
     return logits, tuple(k_out), tuple(v_out)
 
 
@@ -572,7 +751,7 @@ def llama_verify_step(params, cfg: LlamaConfig, tokens, drafts, positions,
     window = jnp.concatenate([tokens[:, None], drafts], axis=1)  # [B, d+1]
     pos_grid = positions[:, None] + jnp.arange(d + 1, dtype=jnp.int32)[None, :]
 
-    x = params["tok_emb"][window]
+    x = _embed(params, cfg, window)
     k_out, v_out = [], []
     for l in range(cfg.n_layers):
         layer = jax.tree_util.tree_map(lambda w: w[l], params["layers"])
@@ -587,7 +766,7 @@ def llama_verify_step(params, cfg: LlamaConfig, tokens, drafts, positions,
     greedy_cols = []
     logits0 = None
     for i in range(d + 1):
-        logits_i = (x[:, i] @ params["lm_head"]).astype(jnp.float32)
+        logits_i = _head(x[:, i], params)
         if i == 0:
             logits0 = logits_i
         greedy_cols.append(jnp.argmax(logits_i, axis=-1).astype(jnp.int32))
@@ -621,7 +800,7 @@ def llama_prefill_chunk_q8(params, cfg: LlamaConfig, tokens, positions,
     dt = _np_dtype(cfg.dtype)
     k_out, v_out = list(k_layers), list(v_layers)
     ks_out, vs_out = list(ks_layers), list(vs_layers)
-    x = params["tok_emb"][tokens]                          # [K, C, D]
+    x = _embed(params, cfg, tokens)                        # [K, C, D]
     batch_idx = jnp.arange(K)[:, None]
     for l in range(cfg.n_layers):
         layer = jax.tree_util.tree_map(lambda w: w[l], params["layers"])
@@ -631,9 +810,9 @@ def llama_prefill_chunk_q8(params, cfg: LlamaConfig, tokens, positions,
         vs_rows = vs_out[l][slots]
 
         normed = rms_norm(x, layer["attn_norm"], cfg.rms_eps)
-        q = (normed @ layer["wq"]).reshape(K, C, H, dh)
-        k = (normed @ layer["wk"]).reshape(K, C, Hkv, dh)
-        v = (normed @ layer["wv"]).reshape(K, C, Hkv, dh)
+        q = _mm(normed, layer, "wq").reshape(K, C, H, dh)
+        k = _mm(normed, layer, "wk").reshape(K, C, Hkv, dh)
+        v = _mm(normed, layer, "wv").reshape(K, C, Hkv, dh)
         q = rope(q, positions, cfg.rope_theta)
         k = rope(k, positions, cfg.rope_theta)
         k8c, ksc = quantize_kv(k, axis=-1)                 # [K,C,Hkv,dh],[K,C,Hkv]
@@ -661,7 +840,7 @@ def llama_prefill_chunk_q8(params, cfg: LlamaConfig, tokens, positions,
         attn = jnp.einsum("bhgts,bhds->bthgd", probs.astype(v_deq.dtype),
                           v_deq,
                           preferred_element_type=jnp.float32).astype(x.dtype)
-        x = x + attn.reshape(K, C, H * dh) @ layer["wo"]
+        x = x + _mm(attn.reshape(K, C, H * dh), layer, "wo")
         x = x + _ffn_block(x, layer, cfg)
 
         k_out[l] = k_out[l].at[slots].set(k_rows8)
@@ -673,7 +852,7 @@ def llama_prefill_chunk_q8(params, cfg: LlamaConfig, tokens, positions,
         return (None,) + out_caches
     x = rms_norm(x, params["final_norm"], cfg.rms_eps)
     last = x[jnp.arange(K), project_last]                  # [K, D]
-    logits = (last @ params["lm_head"]).astype(jnp.float32)
+    logits = _head(last, params)
     return (logits,) + out_caches
 
 
@@ -699,7 +878,7 @@ def llama_decode_step_paged(params, cfg: LlamaConfig, tokens, positions,
 
     B = tokens.shape[0]
     H, Hkv, dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
-    x = params["tok_emb"][tokens][:, None]                 # [B, 1, D]
+    x = _embed(params, cfg, tokens)[:, None]               # [B, 1, D]
     pos_grid = positions[:, None]                          # [B, 1]
 
     def layer_body(l, state):
@@ -708,15 +887,15 @@ def llama_decode_step_paged(params, cfg: LlamaConfig, tokens, positions,
         kp_l = jax.lax.dynamic_index_in_dim(k_pool, l, 0, keepdims=False)
         vp_l = jax.lax.dynamic_index_in_dim(v_pool, l, 0, keepdims=False)
         normed = rms_norm(x, layer["attn_norm"], cfg.rms_eps)
-        q = rope((normed @ layer["wq"]).reshape(B, 1, H, dh), pos_grid,
+        q = rope(_mm(normed, layer, "wq").reshape(B, 1, H, dh), pos_grid,
                  cfg.rope_theta)
-        k = rope((normed @ layer["wk"]).reshape(B, 1, Hkv, dh), pos_grid,
+        k = rope(_mm(normed, layer, "wk").reshape(B, 1, Hkv, dh), pos_grid,
                  cfg.rope_theta)
-        v = (normed @ layer["wv"]).reshape(B, 1, Hkv, dh)
+        v = _mm(normed, layer, "wv").reshape(B, 1, Hkv, dh)
         kp_l, vp_l = paged_write_decode(kp_l, vp_l, k[:, 0], v[:, 0],
                                         table, positions)
         attn = paged_attention(q[:, 0], kp_l, vp_l, table, positions + 1)
-        x = x + (attn.reshape(B, 1, H * dh) @ layer["wo"])
+        x = x + _mm(attn.reshape(B, 1, H * dh), layer, "wo")
         x = x + _ffn_block(x, layer, cfg)
         k_pool = jax.lax.dynamic_update_index_in_dim(k_pool, kp_l, l, 0)
         v_pool = jax.lax.dynamic_update_index_in_dim(v_pool, vp_l, l, 0)
@@ -725,7 +904,7 @@ def llama_decode_step_paged(params, cfg: LlamaConfig, tokens, positions,
     x, k_pool, v_pool = jax.lax.fori_loop(
         0, cfg.n_layers, layer_body, (x, k_pool, v_pool))
     x = rms_norm(x, params["final_norm"], cfg.rms_eps)
-    logits = (x[:, 0] @ params["lm_head"]).astype(jnp.float32)
+    logits = _head(x[:, 0], params)
     return logits, k_pool, v_pool
 
 
@@ -745,7 +924,7 @@ def llama_decode_step_paged_q8(params, cfg: LlamaConfig, tokens, positions,
 
     B = tokens.shape[0]
     H, Hkv, dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
-    x = params["tok_emb"][tokens][:, None]                 # [B, 1, D]
+    x = _embed(params, cfg, tokens)[:, None]               # [B, 1, D]
     pos_grid = positions[:, None]
     ps = k_pool.shape[-1]
     # scale writes share the value writer's index rule (paged_write_decode)
@@ -760,11 +939,11 @@ def llama_decode_step_paged_q8(params, cfg: LlamaConfig, tokens, positions,
         ksp_l = jax.lax.dynamic_index_in_dim(ks_pool, l, 0, keepdims=False)
         vsp_l = jax.lax.dynamic_index_in_dim(vs_pool, l, 0, keepdims=False)
         normed = rms_norm(x, layer["attn_norm"], cfg.rms_eps)
-        q = rope((normed @ layer["wq"]).reshape(B, 1, H, dh), pos_grid,
+        q = rope(_mm(normed, layer, "wq").reshape(B, 1, H, dh), pos_grid,
                  cfg.rope_theta)
-        k = rope((normed @ layer["wk"]).reshape(B, 1, Hkv, dh), pos_grid,
+        k = rope(_mm(normed, layer, "wk").reshape(B, 1, Hkv, dh), pos_grid,
                  cfg.rope_theta)
-        v = (normed @ layer["wv"]).reshape(B, 1, Hkv, dh)
+        v = _mm(normed, layer, "wv").reshape(B, 1, Hkv, dh)
         k8, ks = quantize_kv(k[:, 0], axis=-1)             # [B,Hkv,dh],[B,Hkv]
         v8, vs = quantize_kv(v[:, 0], axis=-1)
         kp_l, vp_l = paged_write_decode(kp_l, vp_l, k8, v8, table, positions)
@@ -772,7 +951,7 @@ def llama_decode_step_paged_q8(params, cfg: LlamaConfig, tokens, positions,
         vsp_l = vsp_l.at[page_ids, :, offsets].set(vs)
         attn = paged_attention(q[:, 0], kp_l, vp_l, table, positions + 1,
                                ksp_l, vsp_l)
-        x = x + (attn.reshape(B, 1, H * dh) @ layer["wo"])
+        x = x + _mm(attn.reshape(B, 1, H * dh), layer, "wo")
         x = x + _ffn_block(x, layer, cfg)
         k_pool = jax.lax.dynamic_update_index_in_dim(k_pool, kp_l, l, 0)
         v_pool = jax.lax.dynamic_update_index_in_dim(v_pool, vp_l, l, 0)
@@ -783,7 +962,7 @@ def llama_decode_step_paged_q8(params, cfg: LlamaConfig, tokens, positions,
     x, k_pool, v_pool, ks_pool, vs_pool = jax.lax.fori_loop(
         0, cfg.n_layers, layer_body, (x, k_pool, v_pool, ks_pool, vs_pool))
     x = rms_norm(x, params["final_norm"], cfg.rms_eps)
-    logits = (x[:, 0] @ params["lm_head"]).astype(jnp.float32)
+    logits = _head(x[:, 0], params)
     return logits, k_pool, v_pool, ks_pool, vs_pool
 
 
@@ -797,9 +976,9 @@ def _attention_block_nocache(x, layer, positions, cfg: LlamaConfig,
     B, T, _ = x.shape
     H, Hkv, dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     normed = rms_norm(x, layer["attn_norm"], cfg.rms_eps)
-    q = rope((normed @ layer["wq"]).reshape(B, T, H, dh), positions, cfg.rope_theta)
-    k = rope((normed @ layer["wk"]).reshape(B, T, Hkv, dh), positions, cfg.rope_theta)
-    v = (normed @ layer["wv"]).reshape(B, T, Hkv, dh)
+    q = rope(_mm(normed, layer, "wq").reshape(B, T, H, dh), positions, cfg.rope_theta)
+    k = rope(_mm(normed, layer, "wk").reshape(B, T, Hkv, dh), positions, cfg.rope_theta)
+    v = _mm(normed, layer, "wv").reshape(B, T, Hkv, dh)
     if attn_fn is not None:
         attn = attn_fn(q, k, v)
     elif cfg.attn_impl == "flash":
@@ -810,7 +989,7 @@ def _attention_block_nocache(x, layer, positions, cfg: LlamaConfig,
         from ..ops.flash_attention import attention_reference
 
         attn = attention_reference(q, k, v, causal=True)
-    return attn.reshape(B, T, H * dh) @ layer["wo"]
+    return _mm(attn.reshape(B, T, H * dh), layer, "wo")
 
 
 def forward_nocache_at(params, cfg: LlamaConfig, tokens, positions,
@@ -820,7 +999,7 @@ def forward_nocache_at(params, cfg: LlamaConfig, tokens, positions,
     The shared body behind llama_forward_nocache and the sequence-parallel
     forward (parallel/longcontext.py), which calls it per device with its
     chunk's position offset and a collective attention primitive."""
-    x = params["tok_emb"][tokens]
+    x = _embed(params, cfg, tokens)
 
     def body(x, layer):
         x = x + _attention_block_nocache(x, layer, positions, cfg, attn_fn)
@@ -829,7 +1008,7 @@ def forward_nocache_at(params, cfg: LlamaConfig, tokens, positions,
 
     x, _ = jax.lax.scan(body, x, params["layers"])
     x = rms_norm(x, params["final_norm"], cfg.rms_eps)
-    return (x @ params["lm_head"]).astype(jnp.float32)
+    return _head(x, params)
 
 
 def llama_forward_nocache(params, cfg: LlamaConfig, tokens):

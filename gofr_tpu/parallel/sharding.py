@@ -52,7 +52,7 @@ def llama_param_specs(moe: bool = False) -> Dict[str, Any]:
     }
 
 
-def serving_param_specs() -> Dict[str, Any]:
+def serving_param_specs(quantized: bool = False) -> Dict[str, Any]:
     """PartitionSpec pytree for the SERVING engine: tp only.
 
     No pp axis — the stacked [L, ...] layer axis stays whole so the decode
@@ -62,6 +62,11 @@ def serving_param_specs() -> Dict[str, Any]:
     per shard, matching the KV cache's Hkv shard (kv_cache_spec). tok_emb
     is replicated (token-id gather at arbitrary ids beats a vocab-sharded
     gather+psum for decode's tiny T); lm_head stays column-parallel.
+
+    quantized=True matches an int8 tree (models.llama.quantize_weights):
+    each per-output-channel scale vector shards exactly like its weight's
+    OUTPUT axis — column-parallel weights get tp-sharded scales, row-
+    parallel weights (wo/w_down, contraction sharded) keep whole scales.
     """
     layers = {
         "wq": _P(None, None, "tp"),
@@ -74,12 +79,23 @@ def serving_param_specs() -> Dict[str, Any]:
         "attn_norm": _P(None, None),
         "ffn_norm": _P(None, None),
     }
-    return {
+    if quantized:
+        layers.update({
+            "wq_s": _P(None, "tp"), "wk_s": _P(None, "tp"),
+            "wv_s": _P(None, "tp"), "wo_s": _P(None, None),
+            "w_gate_s": _P(None, "tp"), "w_up_s": _P(None, "tp"),
+            "w_down_s": _P(None, None),
+        })
+    out = {
         "tok_emb": _P(None, None),
         "layers": layers,
         "final_norm": _P(None),
         "lm_head": _P(None, "tp"),
     }
+    if quantized:
+        out["tok_emb_s"] = _P(None)      # per-row scales ride the gather
+        out["lm_head_s"] = _P("tp")      # column scales follow the vocab split
+    return out
 
 
 def kv_cache_spec():

@@ -105,7 +105,8 @@ def plan_capacity(cfg, n_slots: int, max_seq_len: int,
                   paged: bool = False,
                   clamp: bool = True,
                   min_slots: int = 1,
-                  min_seq: int = 128) -> CapacityPlan:
+                  min_seq: int = 128,
+                  params_nbytes: Optional[int] = None) -> CapacityPlan:
     """Compute the fit; optionally shrink (n_slots, max_seq_len) until it fits.
 
     budget_bytes: the device's bytes_limit (TPUClient.memory_stats()). A
@@ -118,15 +119,20 @@ def plan_capacity(cfg, n_slots: int, max_seq_len: int,
     wide-batch config sheds slots first. Raises ValueError if even the
     minimum config cannot fit (serving would be impossible, matching the
     reference's fail-fast on unusable config).
+
+    params_nbytes: the ACTUAL weight-tree bytes when known (the engine
+    measures its tree) — overrides the analytic cfg-dtype estimate, which
+    is 2x wrong for int8-quantized weights.
     """
+    p_known = params_nbytes if params_nbytes else params_bytes(cfg)
     if budget_bytes <= 0:
         # CPU/unknown backends report no limit: trust the caller's config
         buckets = tuple(b for b in prefill_buckets if b <= max_seq_len)
         return CapacityPlan(n_slots, max_seq_len, buckets, 0,
-                            params_bytes(cfg), kv_cache_bytes(cfg, n_slots, max_seq_len),
+                            p_known, kv_cache_bytes(cfg, n_slots, max_seq_len),
                             0, 0, fits=True, clamped=False)
 
-    p_bytes = params_bytes(cfg)
+    p_bytes = p_known
     usable = int(budget_bytes * safety_frac)
     requested = (n_slots, max_seq_len)
 

@@ -252,6 +252,11 @@ class LLMEngine:
         native.available()  # build/load the C++ helpers at boot, not in the
         # serving loop (first pad_batch call must never stall a decode step)
         self.mesh = mesh
+        # int8-quantized weight tree (models.llama.quantize_weights): the
+        # tree carries companion *_s scale leaves and every matmul routes
+        # through the int8 MXU path at trace time — nothing engine-side
+        # changes except shard specs and the capacity plan's weight bytes
+        self._w8 = isinstance(params, dict) and "lm_head_s" in params
         if mesh is not None:
             from ..parallel.sharding import serving_param_specs, shard_params
 
@@ -260,7 +265,8 @@ class LLMEngine:
                 raise ValueError(
                     f"tp={tp} must divide n_kv_heads={cfg.n_kv_heads} and "
                     f"n_heads={cfg.n_heads} (whole heads per shard)")
-            params = shard_params(params, mesh, serving_param_specs())
+            params = shard_params(params, mesh,
+                                  serving_param_specs(quantized=self._w8))
         self.params = params
         self.cfg = cfg
         self.n_slots = n_slots
@@ -274,10 +280,22 @@ class LLMEngine:
         if budget_bytes is not None and budget_bytes > 0:
             from .capacity import plan_capacity
 
+            from ..models.llama import params_nbytes as _tree_nbytes
+
+            # the plan sums GLOBAL bytes (params_nbytes of a sharded tree
+            # and kv_cache_bytes are whole-model numbers), so under a mesh
+            # the budget is the whole slice's HBM: per-device bytes_limit x
+            # mesh size. Slight over-estimate for replicated leaves
+            # (norms, tok_emb, activation temps) — the sharded weight/cache
+            # terms dominate by orders of magnitude.
+            if mesh is not None:
+                budget_bytes *= mesh.size
+
             self.plan = plan_capacity(cfg, self.n_slots, self.max_seq_len,
                                       budget_bytes,
                                       prefill_buckets=self.prefill_buckets,
-                                      paged=self._plan_paged)
+                                      paged=self._plan_paged,
+                                      params_nbytes=_tree_nbytes(self.params))
             self.n_slots = self.plan.n_slots
             self.max_seq_len = self.plan.max_seq_len
             self.prefill_buckets = self.plan.prefill_buckets
@@ -301,7 +319,13 @@ class LLMEngine:
         # hand one config the other's compiled program. Prefill names carry
         # the attn_impl (its T==S window hits the flash branch); decode
         # names carry decode_attn (its T=1 read hits the kernel branch).
-        self._attn_suffix = "-flash" if cfg.attn_impl == "flash" else ""
+        # "-w8" marks int8-weight trees: the arg-shape cache key already
+        # separates them, but names must too (disk-cache filenames and the
+        # "program identity is visible in logs" rule). Every program-name
+        # site (prefill/chunk/decode/verify + the paged subclass) carries it
+        self._w8_tag = "-w8" if self._w8 else ""
+        self._attn_suffix = ("-flash" if cfg.attn_impl == "flash"
+                             else "") + self._w8_tag
 
         # int8 KV cache: halves cache HBM traffic (the decode bandwidth
         # bound) and doubles context per GiB. Quantize-on-write + kernel
@@ -885,7 +909,7 @@ class LLMEngine:
     def _chunk_program(self, chunk: int, K: int, first: bool, final: bool):
         jnp = self._jnp
         tag = (f"{'-first' if first else ''}{'-final' if final else ''}"
-               f"-S{self._cache_len}")
+               f"-S{self._cache_len}{self._w8_tag}")
         if self._q8:
             args = (self.params, self.k_cache, self.v_cache, self.k_scale,
                     self.v_scale,
@@ -1132,7 +1156,10 @@ class LLMEngine:
                 self._tokens, self._positions, self._temps, self.rng,
                 jnp.zeros((self.n_slots, d), dtype=jnp.int32),
                 jnp.zeros((self.n_slots,), dtype=jnp.int32))
-        name = f"llama-verify-x{d}-S{self._cache_len}"
+        # attn/weight suffix rides along (ADVICE r3: program identity must
+        # not silently depend on the verify window never hitting the
+        # flash/kernel branch conditions)
+        name = f"llama-verify-x{d}-S{self._cache_len}{self._attn_suffix}"
         return self.executor.compile(name, self._verify_fn(d), args,
                                      donate_argnums=(1, 2))
 
@@ -1213,12 +1240,13 @@ class LLMEngine:
             args = (self.params, self.k_cache, self.v_cache, self.k_scale,
                     self.v_scale, self._tokens, self._positions, self._temps,
                     self.rng)
-            name = f"llama-decode-q8-x{block}-S{self._cache_len}"
+            name = f"llama-decode-q8-x{block}-S{self._cache_len}{self._w8_tag}"
             return self.executor.compile(name, self._decode_fn_q8(block),
                                          args, donate_argnums=(1, 2, 3, 4))
         args = (self.params, self.k_cache, self.v_cache,
                 self._tokens, self._positions, self._temps, self.rng)
-        suffix = "-kern" if self.cfg.decode_attn == "kernel" else ""
+        suffix = ("-kern" if self.cfg.decode_attn == "kernel"
+                  else "") + self._w8_tag
         name = f"llama-decode-x{block}-S{self._cache_len}{suffix}"
         return self.executor.compile(name, self._decode_fn(block), args,
                                      donate_argnums=(1, 2))

@@ -345,7 +345,7 @@ class PagedLLMEngine(LLMEngine):
                     self._tokens, self._positions, self._temps,
                     jnp.zeros((K,), dtype=jnp.float32), self.rng)
             return self.executor.compile(
-                f"llama-paged-prefill-q8-{bucket}x{K}",
+                f"llama-paged-prefill-q8-{bucket}x{K}{self._w8_tag}",
                 self._prefill_fn_q8(bucket, K),
                 args, donate_argnums=(1, 2, 3, 4, 9, 10, 11))
         args = (self.params, self.k_cache, self.v_cache,
@@ -356,7 +356,7 @@ class PagedLLMEngine(LLMEngine):
                 self._tokens, self._positions, self._temps,
                 jnp.zeros((K,), dtype=jnp.float32), self.rng)
         return self.executor.compile(
-            f"llama-paged-prefill-{bucket}x{K}",
+            f"llama-paged-prefill-{bucket}x{K}{self._w8_tag}",
             self._prefill_fn(bucket, K),
             args, donate_argnums=(1, 2, 7, 8, 9))
 
@@ -424,14 +424,14 @@ class PagedLLMEngine(LLMEngine):
                     jnp.zeros((self.n_slots, n_table), dtype=jnp.int32),
                     self._tokens, self._positions, self._temps, self.rng)
             return self.executor.compile(
-                f"llama-paged-decode-q8-x{block}-NP{n_table}",
+                f"llama-paged-decode-q8-x{block}-NP{n_table}{self._w8_tag}",
                 self._decode_fn_paged_q8(block, n_table), args,
                 donate_argnums=(1, 2, 3, 4))
         args = (self.params, self.k_cache, self.v_cache,
                 jnp.zeros((self.n_slots, n_table), dtype=jnp.int32),
                 self._tokens, self._positions, self._temps, self.rng)
         return self.executor.compile(
-            f"llama-paged-decode-x{block}-NP{n_table}",
+            f"llama-paged-decode-x{block}-NP{n_table}{self._w8_tag}",
             self._decode_fn_paged(block, n_table), args,
             donate_argnums=(1, 2))
 
