@@ -31,7 +31,7 @@ PRESETS = {
 }
 
 
-def build_engine(app: App) -> LLMEngine:
+def build_engine(app: App, default_sampling_controls: bool = False) -> LLMEngine:
     tpu = TPUClient(app.config)
     app.add_tpu(tpu)
     preset = app.config.get_or_default("MODEL_PRESET", "debug")
@@ -92,11 +92,13 @@ def build_engine(app: App) -> LLMEngine:
     # config 5: Llama-70B TP=8 on v5e-8) — same engine, sharded mesh
     tp = app.config.get_int("TP_SHARDS", 1)
     mesh = tpu.mesh({"tp": tp}, allow_subset=True) if tp > 1 else None
-    # PAGED=true serves from the paged KV pool (block tables + page
-    # allocator + scalar-prefetch Pallas read) instead of the dense
-    # per-slot cache; PAGE_SIZE tokens per page, N_PAGES caps the pool
+    # PAGED (DEFAULT since r4) serves from the paged KV pool (block tables
+    # + page allocator + scalar-prefetch Pallas read); PAGE_SIZE tokens per
+    # page, N_PAGES caps the pool. PAGED=false falls back to the dense
+    # per-slot cache (whose DECODE_ATTN/KV_DTYPE kernel variants remain
+    # the per-row-bandwidth levers for long single streams)
     engine_cls, paged_kw = LLMEngine, {}
-    if app.config.get_bool("PAGED", False):
+    if app.config.get_bool("PAGED", True):
         from gofr_tpu.tpu.paging import PagedLLMEngine
 
         engine_cls = PagedLLMEngine
@@ -134,6 +136,11 @@ def build_engine(app: App) -> LLMEngine:
         # tokens verified per dispatch; greedy output is identical, wins
         # come on self-repetitive text (RAG, code edits, summaries)
         speculative_tokens=app.config.get_int("SPECULATIVE_TOKENS", 0),
+        # per-request top_p/top_k ([B, 3] row controls; one [B, V] sort
+        # per sampled step). Off by default for lean greedy serving; the
+        # OpenAI server defaults it ON (it must honor client top_p)
+        sampling_controls=app.config.get_bool("SAMPLING_CONTROLS",
+                                              default_sampling_controls),
         **paged_kw,
     )
     engine.tokenizer = tokenizer
@@ -170,13 +177,22 @@ def main() -> None:
             priority = max(0, min(9, int(body.get("priority", 0))))
             # EOS is ignored until this floor is reached
             min_tokens = max(0, int(body.get("min_tokens", 0) or 0))
+            # per-request truncation (needs SAMPLING_CONTROLS=true; the
+            # engine 400s them otherwise via the ValueError below)
+            top_p = float(body.get("top_p", 0.0) or 0.0)
+            top_k = int(body.get("top_k", 0) or 0)
         except (TypeError, ValueError) as exc:
-            raise InvalidParam(["priority", "min_tokens"]) from exc
-        request = engine.submit(
-            tokenizer.encode(prompt), max_new_tokens=max_tokens,
-            temperature=temperature, stop_tokens={tokenizer.EOS},
-            span=ctx.span,  # batch.id/slot correlation lands on this span
-            priority=priority, min_tokens=min_tokens)
+            raise InvalidParam(["priority", "min_tokens", "top_p",
+                                "top_k"]) from exc
+        try:
+            request = engine.submit(
+                tokenizer.encode(prompt), max_new_tokens=max_tokens,
+                temperature=temperature, stop_tokens={tokenizer.EOS},
+                span=ctx.span,  # batch.id/slot correlation lands on span
+                priority=priority, min_tokens=min_tokens, top_p=top_p,
+                top_k=top_k)
+        except ValueError as exc:
+            raise InvalidParam([str(exc)]) from exc
 
         if not stream:
             from gofr_tpu.http.errors import RequestTimeout

@@ -59,24 +59,66 @@ def _render_chat(messages) -> str:
 
 def build_app(**kw) -> App:
     app = App(**kw)
-    engine = build_engine(app)
+    # sampling_controls ON by default: an OpenAI surface must honor client
+    # top_p (SAMPLING_CONTROLS=false trades that for a leaner sampler)
+    engine = build_engine(app, default_sampling_controls=True)
+    app.engine = engine    # reachable for operators/tests (llm-server parity)
     tokenizer = engine.tokenizer
     model_id = app.config.get_or_default("MODEL_PRESET", "debug")
+
+    # parameters this surface cannot honor are REJECTED (400), never
+    # silently ignored — a client that sent frequency_penalty=0.8 must not
+    # get un-penalized text labeled as if its request was honored. The
+    # no-op defaults (0 penalties, empty logit_bias, best_of=1) pass, since
+    # SDKs send them unprompted.
+    _UNSUPPORTED_NONDEFAULT = (
+        ("presence_penalty", lambda v: float(v) != 0.0),
+        ("frequency_penalty", lambda v: float(v) != 0.0),
+        ("logit_bias", lambda v: bool(v)),
+        # logprobs=0 still requests the chosen token's logprob (the OpenAI
+        # default is null/absent, not 0) — only absence is a no-op
+        ("logprobs", lambda v: v is not None),
+        ("top_logprobs", lambda v: bool(v)),
+        ("best_of", lambda v: int(v) > 1),
+        ("suffix", lambda v: bool(v)),
+    )
 
     def _params(body: dict):
         """Parse/validate the shared generation params once (a bad type is
         a 400 parameter error, not a 500)."""
+        for name, is_nondefault in _UNSUPPORTED_NONDEFAULT:
+            if name in body:
+                try:
+                    nondefault = is_nondefault(body[name])
+                except (TypeError, ValueError) as exc:
+                    raise InvalidParam([name]) from exc
+                if nondefault:
+                    raise InvalidParam(
+                        [f"{name} is not supported by this server"])
         try:
             max_tokens = int(body.get("max_tokens", 16))
             temperature = float(body.get("temperature", 1.0))
+            # top_p=1.0 is the OpenAI default (no truncation) -> disabled;
+            # top_k is the common extension (0 disables)
+            top_p = float(body.get("top_p", 1.0))
+            top_k = int(body.get("top_k", 0))
             # extension (vLLM-style): stop conditions suppressed until
             # this floor of emitted tokens
             min_tokens = int(body.get("min_tokens", 0))
         except (TypeError, ValueError) as exc:
-            raise InvalidParam(["max_tokens", "temperature",
-                               "min_tokens"]) from exc
+            raise InvalidParam(["max_tokens", "temperature", "top_p",
+                                "top_k", "min_tokens"]) from exc
         if max_tokens < 1:
             raise InvalidParam(["max_tokens"])
+        if not 0.0 < top_p <= 1.0:
+            raise InvalidParam(["top_p must be in (0, 1]"])
+        if top_k < 0:
+            raise InvalidParam(["top_k must be >= 0"])
+        if top_p >= 1.0:
+            top_p = 0.0                       # 1.0 == keep everything
+        if (top_p or top_k) and not engine.sampling_controls:
+            raise InvalidParam(
+                ["top_p/top_k need SAMPLING_CONTROLS=true on this server"])
         if not 0 <= min_tokens <= max_tokens:
             raise InvalidParam(["min_tokens must be 0..max_tokens"])
         stop = body.get("stop") or []
@@ -84,7 +126,7 @@ def build_app(**kw) -> App:
             stop = [stop]
         if not all(isinstance(s, str) for s in stop):
             raise InvalidParam(["stop"])
-        return max_tokens, temperature, stop, min_tokens
+        return max_tokens, temperature, stop, min_tokens, top_p, top_k
 
     def _encode_checked(prompt: str):
         prompt_tokens = tokenizer.encode(prompt)
@@ -97,17 +139,18 @@ def build_app(**kw) -> App:
         return prompt_tokens
 
     def _submit_tokens(prompt_tokens, max_tokens: int, temperature: float,
-                       min_tokens: int = 0):
+                       min_tokens: int = 0, top_p: float = 0.0,
+                       top_k: int = 0):
         return engine.submit(prompt_tokens, max_new_tokens=max_tokens,
                              temperature=temperature,
                              stop_tokens={tokenizer.EOS},
-                             min_tokens=min_tokens)
+                             min_tokens=min_tokens, top_p=top_p, top_k=top_k)
 
     def _submit(prompt: str, max_tokens: int, temperature: float,
-                min_tokens: int = 0):
+                min_tokens: int = 0, top_p: float = 0.0, top_k: int = 0):
         prompt_tokens = _encode_checked(prompt)
         return _submit_tokens(prompt_tokens, max_tokens, temperature,
-                              min_tokens), prompt_tokens
+                              min_tokens, top_p, top_k), prompt_tokens
 
     def _finish_reason(n_emitted: int, max_tokens: int) -> str:
         return "length" if n_emitted >= max_tokens else "stop"
@@ -131,7 +174,7 @@ def build_app(**kw) -> App:
         return len(tokenizer.decode(tokens[:min_tokens]))
 
     def _multi_completion(ctx, chat, prompt, n_choices, max_tokens,
-                          temperature, stop_strs, min_tokens):
+                          temperature, stop_strs, min_tokens, top_p, top_k):
         """n > 1: fan the prompt out as n engine requests (they batch into
         the same continuous-batching slots) and collect n choices. Encode
         once; ANY failure cancels every sibling so abandoned requests
@@ -142,7 +185,8 @@ def build_app(**kw) -> App:
         try:
             for _ in range(n_choices):
                 requests.append(_submit_tokens(prompt_toks, max_tokens,
-                                               temperature, min_tokens))
+                                               temperature, min_tokens,
+                                               top_p, top_k))
             for idx, req in enumerate(requests):
                 try:
                     tokens = req.result(timeout_s=ctx.remaining())
@@ -192,7 +236,8 @@ def build_app(**kw) -> App:
             prompt = body.get("prompt")
             if not isinstance(prompt, str) or not prompt:
                 raise InvalidParam(["prompt"])
-        max_tokens, temperature, stop_strs, min_tokens = _params(body)
+        (max_tokens, temperature, stop_strs, min_tokens, top_p,
+         top_k) = _params(body)
         try:
             n_choices = int(body.get("n", 1))
         except (TypeError, ValueError) as exc:
@@ -208,9 +253,9 @@ def build_app(**kw) -> App:
                 raise InvalidParam(["n > 1 requires temperature > 0"])
             return _multi_completion(ctx, chat, prompt, n_choices,
                                      max_tokens, temperature, stop_strs,
-                                     min_tokens)
+                                     min_tokens, top_p, top_k)
         request, prompt_toks = _submit(prompt, max_tokens, temperature,
-                                       min_tokens)
+                                       min_tokens, top_p, top_k)
         created = int(time.time())
         rid = (f"chatcmpl-{uuid.uuid4().hex[:24]}" if chat
                else f"cmpl-{uuid.uuid4().hex[:24]}")
@@ -273,6 +318,12 @@ def build_app(**kw) -> App:
                         sent = safe
                 if not stopped:
                     acc += decoder.flush()
+                    if floor_chars is None:
+                        # stream ended (cancel/engine failure) before
+                        # min_tokens arrived: everything received is inside
+                        # the protected floor — no stop-string scan may
+                        # truncate it (ADVICE r3)
+                        floor_chars = len(acc)
                     cut = min((idx for idx in
                                (acc.find(s, max(floor_chars or 0,
                                                 sent - hold))

@@ -966,6 +966,94 @@ def llama_decode_step_paged_q8(params, cfg: LlamaConfig, tokens, positions,
     return logits, k_pool, v_pool, ks_pool, vs_pool
 
 
+def llama_verify_step_paged(params, cfg: LlamaConfig, tokens, drafts,
+                            positions, k_pool, v_pool, table):
+    """Speculative-decode VERIFY against the PAGED pool.
+
+    Same contract as llama_verify_step (score current token + d drafts in
+    one forward, cache-writing), re-shaped for paged storage:
+
+      - the window's K/V scatter into pages via paged_write_decode, one
+        window position at a time — positions past a slot's reservation
+        map to zero table entries, i.e. the garbage page, so overrun junk
+        can never land in a live page (the allocator invariant)
+      - the window attention gathers each slot's pages into contiguous
+        [B, Hkv, dh, NP*ps] rows (ONE pool read per layer — the paged
+        kernel is a T=1 read; d+1 kernel calls would re-stream the live
+        pages d+1 times) and runs the dense masked einsum over them.
+        Page j of a slot's table covers absolute positions [j*ps, (j+1)*ps),
+        so gathered offset IS absolute position and the `j <= q_pos` mask
+        carries over unchanged.
+
+    Junk-safety mirrors the dense verify: rejected window positions hold
+    junk that the eventual real occupant overwrites before any query
+    attends it (lock-step invariant), and garbage-page content is only
+    reachable at offsets the mask already excludes for live queries.
+
+    tokens: [B]; drafts: [B, d]; positions: [B]; k/v_pool:
+    [L, P, Hkv, dh, ps]; table: [B, NP].
+    Returns (greedy [B, d+1] int32, logits0 [B, V] f32, k_pool, v_pool).
+    """
+    from ..ops.paged_attention import paged_write_decode
+
+    B, d = drafts.shape
+    H, Hkv, dh, G = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim, cfg.q_per_kv
+    ps = k_pool.shape[-1]
+    NP = table.shape[1]
+    S = NP * ps
+    window = jnp.concatenate([tokens[:, None], drafts], axis=1)  # [B, d+1]
+    pos_grid = positions[:, None] + jnp.arange(d + 1, dtype=jnp.int32)[None, :]
+    x = _embed(params, cfg, window)
+
+    def layer_body(l, state):
+        x, k_pool, v_pool = state
+        layer = jax.tree_util.tree_map(lambda w: w[l], params["layers"])
+        kp_l = jax.lax.dynamic_index_in_dim(k_pool, l, 0, keepdims=False)
+        vp_l = jax.lax.dynamic_index_in_dim(v_pool, l, 0, keepdims=False)
+        normed = rms_norm(x, layer["attn_norm"], cfg.rms_eps)
+        q = rope(_mm(normed, layer, "wq").reshape(B, d + 1, H, dh),
+                 pos_grid, cfg.rope_theta)
+        k = rope(_mm(normed, layer, "wk").reshape(B, d + 1, Hkv, dh),
+                 pos_grid, cfg.rope_theta)
+        v = _mm(normed, layer, "wv").reshape(B, d + 1, Hkv, dh)
+        # window scatter BEFORE the gather so the gathered rows already
+        # contain this window's fresh K/V (the dense verify's .at[].set)
+        for i in range(d + 1):
+            kp_l, vp_l = paged_write_decode(kp_l, vp_l, k[:, i], v[:, i],
+                                            table, positions + i)
+        k_rows = jnp.moveaxis(kp_l[table], 1, 3).reshape(B, Hkv, dh, S)
+        v_rows = jnp.moveaxis(vp_l[table], 1, 3).reshape(B, Hkv, dh, S)
+        qg = q.reshape(B, d + 1, Hkv, G, dh)
+        scores = jnp.einsum("bthgd,bhds->bhgts", qg, k_rows,
+                            preferred_element_type=jnp.float32
+                            ) / math.sqrt(dh)
+        cache_pos = jnp.arange(S)[None, None, :]                 # [1, 1, S]
+        visible = cache_pos <= pos_grid[:, :, None]              # [B, d+1, S]
+        scores = jnp.where(visible[:, None, None, :, :], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        attn = jnp.einsum("bhgts,bhds->bthgd", probs.astype(v_rows.dtype),
+                          v_rows,
+                          preferred_element_type=jnp.float32).astype(x.dtype)
+        x = x + _mm(attn.reshape(B, d + 1, H * dh), layer, "wo")
+        x = x + _ffn_block(x, layer, cfg)
+        k_pool = jax.lax.dynamic_update_index_in_dim(k_pool, kp_l, l, 0)
+        v_pool = jax.lax.dynamic_update_index_in_dim(v_pool, vp_l, l, 0)
+        return x, k_pool, v_pool
+
+    x, k_pool, v_pool = jax.lax.fori_loop(
+        0, cfg.n_layers, layer_body, (x, k_pool, v_pool))
+    x = rms_norm(x, params["final_norm"], cfg.rms_eps)           # [B, d+1, D]
+    greedy_cols = []
+    logits0 = None
+    for i in range(d + 1):
+        logits_i = _head(x[:, i], params)
+        if i == 0:
+            logits0 = logits_i
+        greedy_cols.append(jnp.argmax(logits_i, axis=-1).astype(jnp.int32))
+    greedy = jnp.stack(greedy_cols, axis=1)                      # [B, d+1]
+    return greedy, logits0, k_pool, v_pool
+
+
 def _attention_block_nocache(x, layer, positions, cfg: LlamaConfig,
                              attn_fn=None):
     """Plain causal attention sublayer (no cache). x: [B, T, D] -> [B, T, D].

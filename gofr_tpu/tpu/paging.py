@@ -92,15 +92,12 @@ class PagedLLMEngine(LLMEngine):
 
     def __init__(self, params, cfg: LlamaConfig, *, page_size: int = 128,
                  n_pages: Optional[int] = None, **kw):
-        if kw.get("chunk_prefill_tokens"):
-            # the chunk path assumes per-layer dense slot-row caches; over
-            # the stacked page pool it would scatter prompt KV into
-            # arbitrary pages — reject loudly rather than corrupt
-            raise ValueError("chunked prefill is not supported by the paged "
-                             "engine yet (dense LLMEngine only)")
-        if kw.get("speculative_tokens"):
-            raise ValueError("speculative decoding is not supported by the "
-                             "paged engine yet (dense LLMEngine only)")
+        # chunked prefill runs against bucket-sized per-job TEMPS and
+        # scatters into pages once at the final chunk (_chunk_fn_paged);
+        # speculative verify gathers pages into contiguous rows per layer
+        # (llama_verify_step_paged). Both compose with the pool since r4;
+        # the spec+int8-KV and spec+chunk exclusions are inherited from
+        # the dense engine (same reasons apply)
         self.page_size = page_size
         self._requested_pages = n_pages
         # set pre-super: _init_device_state runs inside super().__init__
@@ -149,7 +146,7 @@ class PagedLLMEngine(LLMEngine):
         B = self.n_slots
         self._tokens = jnp.zeros((B,), dtype=jnp.int32)
         self._positions = jnp.zeros((B,), dtype=jnp.int32)
-        self._temps = jnp.zeros((B,), dtype=jnp.float32)
+        self._temps = self._temps_init(B)
         self.rng = jax.random.PRNGKey(next(self._reset_counter))
         if self.mesh is not None:
             self._place_state()
@@ -194,7 +191,8 @@ class PagedLLMEngine(LLMEngine):
     def submit(self, prompt_tokens, max_new_tokens: int = 128,
                temperature: float = 0.0, stop_tokens=None,
                span=None, priority: int = 0,
-               min_tokens: int = 0) -> GenerationRequest:
+               min_tokens: int = 0, top_p: float = 0.0,
+               top_k: int = 0) -> GenerationRequest:
         """Reject requests whose reservation could NEVER fit the pool:
         parking them would permanently occupy the admission heap's head
         for their priority class behind an allocation that cannot
@@ -209,7 +207,8 @@ class PagedLLMEngine(LLMEngine):
                 f"usable pages; shrink max_new_tokens or grow n_pages")
         return super().submit(prompt_tokens, max_new_tokens, temperature,
                               stop_tokens, span=span, priority=priority,
-                              min_tokens=min_tokens)
+                              min_tokens=min_tokens, top_p=top_p,
+                              top_k=top_k)
 
     def _request_pages(self, request: GenerationRequest) -> int:
         total = min(len(request.prompt_tokens) + request.max_new_tokens,
@@ -241,8 +240,19 @@ class PagedLLMEngine(LLMEngine):
     # -- programs -------------------------------------------------------------
     def warmup(self, grow: bool = True) -> None:
         with self._state_lock:
+            chunk = self.chunk_prefill_tokens
             for bucket in self.prefill_buckets:
-                self._prefill_program(bucket, 1)
+                # buckets routed to the chunk path skip the (dead) fused
+                # program, mirroring the dense warmup's routing
+                if not (chunk and bucket > chunk):
+                    self._prefill_program(bucket, 1)
+            if chunk:
+                for bucket in self.prefill_buckets:
+                    if bucket > chunk:  # warm that bucket's mid+final pair
+                        self._chunk_program_paged(chunk, 1, bucket,
+                                                  final=False)
+                        self._chunk_program_paged(chunk, 1, bucket,
+                                                  final=True)
             # warm the table widths the first admissions will actually hit:
             # dispatch uses pow2(widest_pages + 1), so NP=1 never occurs
             warm_widths = set()
@@ -257,6 +267,8 @@ class PagedLLMEngine(LLMEngine):
                     # pressure — exactly when a compile stall hurts most
                     self._decode_program_paged(
                         width, max(1, self.decode_block_size // 2))
+                if self.speculative_tokens:
+                    self._verify_program(width)
 
     def _prefill_fn(self, bucket: int, K: int):
         cfg = self.cfg
@@ -343,9 +355,9 @@ class PagedLLMEngine(LLMEngine):
                     jnp.zeros((K,), dtype=jnp.int32),
                     jnp.ones((K,), dtype=jnp.int32),
                     self._tokens, self._positions, self._temps,
-                    jnp.zeros((K,), dtype=jnp.float32), self.rng)
+                    self._temps_init(K), self.rng)
             return self.executor.compile(
-                f"llama-paged-prefill-q8-{bucket}x{K}{self._w8_tag}",
+                f"llama-paged-prefill-q8-{bucket}x{K}{self._id_tag}",
                 self._prefill_fn_q8(bucket, K),
                 args, donate_argnums=(1, 2, 3, 4, 9, 10, 11))
         args = (self.params, self.k_cache, self.v_cache,
@@ -354,9 +366,9 @@ class PagedLLMEngine(LLMEngine):
                 jnp.zeros((K,), dtype=jnp.int32),
                 jnp.ones((K,), dtype=jnp.int32),
                 self._tokens, self._positions, self._temps,
-                jnp.zeros((K,), dtype=jnp.float32), self.rng)
+                self._temps_init(K), self.rng)
         return self.executor.compile(
-            f"llama-paged-prefill-{bucket}x{K}{self._w8_tag}",
+            f"llama-paged-prefill-{bucket}x{K}{self._id_tag}",
             self._prefill_fn(bucket, K),
             args, donate_argnums=(1, 2, 7, 8, 9))
 
@@ -424,18 +436,343 @@ class PagedLLMEngine(LLMEngine):
                     jnp.zeros((self.n_slots, n_table), dtype=jnp.int32),
                     self._tokens, self._positions, self._temps, self.rng)
             return self.executor.compile(
-                f"llama-paged-decode-q8-x{block}-NP{n_table}{self._w8_tag}",
+                f"llama-paged-decode-q8-x{block}-NP{n_table}{self._id_tag}",
                 self._decode_fn_paged_q8(block, n_table), args,
                 donate_argnums=(1, 2, 3, 4))
         args = (self.params, self.k_cache, self.v_cache,
                 jnp.zeros((self.n_slots, n_table), dtype=jnp.int32),
                 self._tokens, self._positions, self._temps, self.rng)
         return self.executor.compile(
-            f"llama-paged-decode-x{block}-NP{n_table}{self._w8_tag}",
+            f"llama-paged-decode-x{block}-NP{n_table}{self._id_tag}",
             self._decode_fn_paged(block, n_table), args,
             donate_argnums=(1, 2))
 
+    # -- chunked prefill over the pool ---------------------------------------
+    # A long prompt's chunks run against bucket-sized per-JOB temp caches
+    # (per-layer [K, Hkv, dh, bucket] tuples carried in the job dict — the
+    # same storage shape the fused paged prefill allocates internally), and
+    # the FINAL chunk scatters the whole window into pages with the same
+    # paged_write_prefill_stacked the fused path uses. Decode dispatches
+    # interleave between chunks exactly as in the dense engine; the dense
+    # engine's position-parking is unnecessary here because a reserved-but-
+    # inactive slot's table row is all zeros, so lock-step junk writes land
+    # in the garbage page by construction.
+    def _chunk_fn_paged(self, chunk: int, K: int, final: bool):
+        cfg = self.cfg
+        jnp = self._jnp
+        top_k = self.top_k
+        from ..models.llama import llama_prefill_chunk
+        from .sampling import sample_tokens
+
+        def forward(params, tmp_k, tmp_v, ctokens, cpositions, lengths,
+                    start, selected):
+            tmp_k = tuple(_pin_standard_layout(t) for t in tmp_k)
+            tmp_v = tuple(_pin_standard_layout(t) for t in tmp_v)
+            logits, tmp_k, tmp_v = llama_prefill_chunk(
+                params, cfg, ctokens, cpositions, tmp_k, tmp_v,
+                jnp.arange(K, dtype=jnp.int32),
+                project_last=jnp.clip(lengths - 1 - start, 0, chunk - 1))
+            in_chunk = ((lengths - 1 >= start)
+                        & (lengths - 1 < start + chunk))       # [K]
+            selected = jnp.where(in_chunk[:, None], logits, selected)
+            return tmp_k, tmp_v, selected
+
+        if not final:
+            def run_chunk(params, tmp_k, tmp_v, ctokens, cpositions,
+                          lengths, start, selected):
+                tmp_k, tmp_v, selected = forward(
+                    params, tmp_k, tmp_v, ctokens, cpositions, lengths,
+                    start, selected)
+                return tmp_k, tmp_v, selected
+
+            return run_chunk
+
+        def run_final(params, k_pool, v_pool, tmp_k, tmp_v, ctokens,
+                      cpositions, ptable, slots, lengths, start, selected,
+                      tokens, positions, temps, new_temps, rng):
+            k_pool, v_pool = _pin_standard_layout(k_pool, v_pool)
+            tmp_k, tmp_v, selected = forward(
+                params, tmp_k, tmp_v, ctokens, cpositions, lengths, start,
+                selected)
+            k_pool, v_pool = paged_write_prefill_stacked(
+                k_pool, v_pool, jnp.stack(tmp_k), jnp.stack(tmp_v),
+                ptable, lengths)
+            first_tok, rng = sample_tokens(selected, rng, new_temps,
+                                           top_k=top_k)
+            tokens = tokens.at[slots].set(first_tok)
+            positions = positions.at[slots].set(lengths)
+            temps = temps.at[slots].set(new_temps)
+            k_pool, v_pool = _pin_standard_layout(k_pool, v_pool)
+            return k_pool, v_pool, tokens, positions, temps, rng, first_tok
+
+        return run_final
+
+    def _chunk_fn_paged_q8_final(self, chunk: int, K: int):
+        """Final chunk into INT8 pools: the whole full-precision temp
+        window quantizes ONCE at the scatter (per token/head scales) —
+        mid-chunks read full-precision temps, so chunked q8 admission is
+        numerically CLOSER to the fused path than the dense engine's
+        chunked-q8 (which re-reads earlier chunks quantized)."""
+        cfg = self.cfg
+        jnp = self._jnp
+        top_k = self.top_k
+        from ..ops.decode_attention import quantize_kv
+        from ..ops.paged_attention import paged_write_prefill_scales
+        from .sampling import sample_tokens
+
+        base = self._chunk_fn_paged(chunk, K, final=False)
+
+        def run_final(params, k_pool, v_pool, k_scale, v_scale, tmp_k,
+                      tmp_v, ctokens, cpositions, ptable, slots, lengths,
+                      start, selected, tokens, positions, temps, new_temps,
+                      rng):
+            k_pool, v_pool = _pin_standard_layout(k_pool, v_pool)
+            tmp_k, tmp_v, selected = base(
+                params, tmp_k, tmp_v, ctokens, cpositions, lengths, start,
+                selected)
+            k8, ks = quantize_kv(jnp.stack(tmp_k), axis=-2)
+            v8, vs = quantize_kv(jnp.stack(tmp_v), axis=-2)
+            k_pool, v_pool = paged_write_prefill_stacked(
+                k_pool, v_pool, k8, v8, ptable, lengths)
+            k_scale = paged_write_prefill_scales(k_scale, ks, ptable, lengths)
+            v_scale = paged_write_prefill_scales(v_scale, vs, ptable, lengths)
+            first_tok, rng = sample_tokens(selected, rng, new_temps,
+                                           top_k=top_k)
+            tokens = tokens.at[slots].set(first_tok)
+            positions = positions.at[slots].set(lengths)
+            temps = temps.at[slots].set(new_temps)
+            k_pool, v_pool = _pin_standard_layout(k_pool, v_pool)
+            return (k_pool, v_pool, k_scale, v_scale, tokens, positions,
+                    temps, rng, first_tok)
+
+        return run_final
+
+    def _chunk_program_paged(self, chunk: int, K: int, bucket: int,
+                             final: bool):
+        """Unlike the dense engine's (chunk, K)-keyed chunk programs, the
+        paged variants also key on the BUCKET (the temp caches are bucket-
+        wide); buckets above the chunk size are few, so the compile set
+        stays bounded."""
+        jnp = self._jnp
+        from ..models.llama import _np_dtype
+
+        Hkv, dh = self.cfg.n_kv_heads, self.cfg.head_dim
+        L = self.cfg.n_layers
+        dt = _np_dtype(self.cfg.dtype)
+        tmp = tuple(jnp.zeros((K, Hkv, dh, bucket), dtype=dt)
+                    for _ in range(L))
+        common = (jnp.zeros((K, chunk), dtype=jnp.int32),
+                  jnp.zeros((K, chunk), dtype=jnp.int32))
+        if not final:
+            args = (self.params, tmp, tmp, *common,
+                    jnp.ones((K,), dtype=jnp.int32),
+                    jnp.zeros((), dtype=jnp.int32),
+                    jnp.zeros((K, self.cfg.vocab_size), dtype=jnp.float32))
+            return self.executor.compile(
+                f"llama-paged-chunk-{chunk}x{K}-b{bucket}{self._id_tag}",
+                self._chunk_fn_paged(chunk, K, final=False), args,
+                donate_argnums=(1, 2, 7))
+        n_ptable = max(1, math.ceil(bucket / self.page_size))
+        tail = (jnp.zeros((K, n_ptable), dtype=jnp.int32),
+                jnp.zeros((K,), dtype=jnp.int32),
+                jnp.ones((K,), dtype=jnp.int32),
+                jnp.zeros((), dtype=jnp.int32),
+                jnp.zeros((K, self.cfg.vocab_size), dtype=jnp.float32),
+                self._tokens, self._positions, self._temps,
+                self._temps_init(K), self.rng)
+        if self._q8:
+            args = (self.params, self.k_cache, self.v_cache, self.k_scale,
+                    self.v_scale, tmp, tmp, *common, *tail)
+            return self.executor.compile(
+                f"llama-paged-chunk-q8-final-{chunk}x{K}-b{bucket}"
+                f"{self._id_tag}",
+                self._chunk_fn_paged_q8_final(chunk, K), args,
+                donate_argnums=(1, 2, 3, 4, 5, 6, 13, 14, 15, 16))
+        args = (self.params, self.k_cache, self.v_cache, tmp, tmp,
+                *common, *tail)
+        return self.executor.compile(
+            f"llama-paged-chunk-final-{chunk}x{K}-b{bucket}{self._id_tag}",
+            self._chunk_fn_paged(chunk, K, final=True), args,
+            donate_argnums=(1, 2, 3, 4, 11, 12, 13, 14))
+
+    def _start_chunk_job(self, bucket: int, slots_idx: List[int],
+                         batch: List[GenerationRequest]) -> None:
+        import time as _time
+
+        jnp = self._jnp
+        from ..models.llama import _np_dtype
+
+        ptokens, lengths, new_temps = self._prep_admission(bucket, batch)
+        K = len(batch)
+        n_ptable = max(1, math.ceil(bucket / self.page_size))
+        ptable = np.zeros((K, n_ptable), dtype=np.int32)
+        for row, request in enumerate(batch):
+            pages = self._reservations.get(request.id)
+            if pages is None:  # direct submit path outside _admit (tests)
+                pages = self.allocator.alloc(self._request_pages(request))
+                if pages is None:
+                    raise RuntimeError("page pool exhausted at dispatch")
+                self._reservations[request.id] = pages
+            prompt_pages = pages[:n_ptable]
+            ptable[row, :len(prompt_pages)] = prompt_pages
+        Hkv, dh = self.cfg.n_kv_heads, self.cfg.head_dim
+        dt = _np_dtype(self.cfg.dtype)
+        tmp_shape = (K, Hkv, dh, bucket)
+
+        def temp():
+            t = tuple(jnp.zeros(tmp_shape, dtype=dt)
+                      for _ in range(self.cfg.n_layers))
+            if self.mesh is not None:
+                import jax
+                from jax.sharding import NamedSharding
+
+                from ..parallel.sharding import kv_cache_layer_spec
+
+                s = NamedSharding(self.mesh, kv_cache_layer_spec())
+                t = tuple(jax.device_put(b, s) for b in t)
+            return t
+
+        job = {
+            "batch": batch, "slots_idx": slots_idx, "bucket": bucket,
+            "chunk": self.chunk_prefill_tokens, "next_start": 0,
+            "ptokens": np.asarray(ptokens), "lengths": lengths,
+            "new_temps": new_temps, "ptable": ptable,
+            "tmp_k": temp(), "tmp_v": temp(),
+            "selected": jnp.zeros((K, self.cfg.vocab_size),
+                                  dtype=jnp.float32),
+        }
+        self._dispatch_chunk(job)
+        now = _time.time()
+        for row, request in enumerate(batch):
+            request.admitted_at = now
+            self._obs.hist("app_tpu_queue_wait_seconds",
+                           now - request.enqueued_at)
+            self.slots[slots_idx[row]].chunking = request
+        self._chunk_jobs.append(job)
+
+    def _dispatch_chunk(self, job) -> bool:
+        jnp = self._jnp
+        batch = job["batch"]
+        K = len(batch)
+        chunk = job["chunk"]
+        start = job["next_start"]
+        final = start + chunk >= job["bucket"]
+        ctokens = job["ptokens"][:, start:start + chunk]
+        cpositions = np.broadcast_to(
+            np.arange(start, start + chunk, dtype=np.int32)[None, :],
+            (K, chunk))
+        program = self._chunk_program_paged(chunk, K, job["bucket"], final)
+        try:
+            if not final:
+                job["tmp_k"], job["tmp_v"], job["selected"] = program(
+                    self.params, job["tmp_k"], job["tmp_v"],
+                    jnp.asarray(ctokens), jnp.asarray(cpositions),
+                    jnp.asarray(job["lengths"]),
+                    jnp.asarray(start, dtype=jnp.int32), job["selected"])
+                first_tok = None
+            elif self._q8:
+                (self.k_cache, self.v_cache, self.k_scale, self.v_scale,
+                 self._tokens, self._positions, self._temps, self.rng,
+                 first_tok) = program(
+                    self.params, self.k_cache, self.v_cache, self.k_scale,
+                    self.v_scale, job["tmp_k"], job["tmp_v"],
+                    jnp.asarray(ctokens), jnp.asarray(cpositions),
+                    jnp.asarray(job["ptable"]),
+                    jnp.asarray(np.asarray(job["slots_idx"],
+                                           dtype=np.int32)),
+                    jnp.asarray(job["lengths"]),
+                    jnp.asarray(start, dtype=jnp.int32), job["selected"],
+                    self._tokens, self._positions, self._temps,
+                    jnp.asarray(job["new_temps"]), self.rng)
+            else:
+                (self.k_cache, self.v_cache, self._tokens, self._positions,
+                 self._temps, self.rng, first_tok) = program(
+                    self.params, self.k_cache, self.v_cache, job["tmp_k"],
+                    job["tmp_v"], jnp.asarray(ctokens),
+                    jnp.asarray(cpositions), jnp.asarray(job["ptable"]),
+                    jnp.asarray(np.asarray(job["slots_idx"],
+                                           dtype=np.int32)),
+                    jnp.asarray(job["lengths"]),
+                    jnp.asarray(start, dtype=jnp.int32), job["selected"],
+                    self._tokens, self._positions, self._temps,
+                    jnp.asarray(job["new_temps"]), self.rng)
+        except Exception as exc:
+            raise CacheLostError(
+                f"paged chunk prefill dispatch failed: {exc}") from exc
+        job["next_start"] = start + chunk
+        job["first_tok"] = first_tok
+        return final
+
+    def _finish_chunk_job(self, job) -> None:
+        super()._finish_chunk_job(job)
+        for slot_idx, request in zip(job["slots_idx"], job["batch"]):
+            self.slots[slot_idx].pages = self._reservations.pop(request.id)
+
+    def _abort_chunk_job(self, job, exc) -> None:
+        for request in job["batch"]:
+            self._abort_admission(request)
+        super()._abort_chunk_job(job, exc)
+
+    # -- speculative decoding over the pool -----------------------------------
+    def _verify_fn_paged(self, d: int, n_table: int):
+        """The paged window forward (llama_verify_step_paged) around the
+        SHARED acceptance epilogue (engine.spec_accept_epilogue — one
+        implementation for both engines by construction)."""
+        cfg = self.cfg
+        top_k = self.top_k
+        from ..models.llama import llama_verify_step_paged
+        from .engine import spec_accept_epilogue
+
+        def verify(params, k_pool, v_pool, table, tokens, positions, temps,
+                   rng, drafts, draft_lens):
+            k_pool, v_pool = _pin_standard_layout(k_pool, v_pool)
+            g, logits0, k_pool, v_pool = llama_verify_step_paged(
+                params, cfg, tokens, drafts, positions, k_pool, v_pool,
+                table)
+            tokens, positions, rng, out, n_emit = spec_accept_epilogue(
+                g, logits0, temps, rng, drafts, draft_lens, positions, d,
+                top_k)
+            k_pool, v_pool = _pin_standard_layout(k_pool, v_pool)
+            return (k_pool, v_pool, tokens, positions, rng, out, n_emit)
+
+        return verify
+
+    def _verify_program(self, n_table: int):
+        jnp = self._jnp
+        d = self.speculative_tokens
+        args = (self.params, self.k_cache, self.v_cache,
+                jnp.zeros((self.n_slots, n_table), dtype=jnp.int32),
+                self._tokens, self._positions, self._temps, self.rng,
+                jnp.zeros((self.n_slots, d), dtype=jnp.int32),
+                jnp.zeros((self.n_slots,), dtype=jnp.int32))
+        name = f"llama-paged-verify-x{d}-NP{n_table}{self._id_tag}"
+        return self.executor.compile(name, self._verify_fn_paged(d, n_table),
+                                     args, donate_argnums=(1, 2))
+
+    def _verify_call(self, drafts, lens):
+        jnp = self._jnp
+        table = self._build_table()
+        program = self._verify_program(table.shape[1])
+        (self.k_cache, self.v_cache, self._tokens, self._positions,
+         self.rng, out_tokens, n_emit) = program(
+            self.params, self.k_cache, self.v_cache, jnp.asarray(table),
+            self._tokens, self._positions, self._temps, self.rng,
+            drafts, lens)
+        return out_tokens, n_emit
+
     # -- dispatch -------------------------------------------------------------
+    def _build_table(self) -> np.ndarray:
+        """Block table for the current active slots, padded to a power-of-
+        two width with one extra garbage column (see _dispatch_decode)."""
+        active = [(i, slot) for i, slot in enumerate(self.slots)
+                  if slot.active]
+        widest = max(len(slot.pages) for _, slot in active)
+        n_table = _pow2_at_least(widest + 1)
+        table = np.zeros((self.n_slots, n_table), dtype=np.int32)
+        for i, slot in active:
+            table[i, :len(slot.pages)] = slot.pages
+        return table
+
     def _dispatch_prefill(self, bucket: int, slots_idx: List[int],
                           batch: List[GenerationRequest]) -> None:
         K = len(batch)
@@ -487,18 +824,16 @@ class PagedLLMEngine(LLMEngine):
         import time as _time
 
         jnp = self._jnp
-        active = [(i, slot) for i, slot in enumerate(self.slots) if slot.active]
-        widest = max(len(slot.pages) for _, slot in active)
-        # +1 garbage column: a speculative overrun position clamps its
-        # page_slot to the LAST column, which must be garbage (0) for every
-        # row so dead steps can never write into a live page
-        n_table = _pow2_at_least(widest + 1)
-        table = np.zeros((self.n_slots, n_table), dtype=np.int32)
-        for i, slot in active:
-            table[i, :len(slot.pages)] = slot.pages
+        # table width includes +1 garbage column: a speculative overrun
+        # position clamps its page_slot to the LAST column, which must be
+        # garbage (0) for every row so dead steps can never write into a
+        # live page
+        table = self._build_table()
+        n_table = table.shape[1]
         block = self._decode_block_now()
         program = self._decode_program_paged(n_table, block)
-        snapshot = [(i, slot.request) for i, slot in active]
+        snapshot = [(i, slot.request) for i, slot in enumerate(self.slots)
+                    if slot.active]
         start = _time.time()
         try:
             if self._q8:

@@ -3,6 +3,14 @@
 All branches are compiled into one program (lax.cond-free masking) so the
 decode step stays a single XLA executable regardless of per-request settings:
 temperature==0 rows take the argmax path via jnp.where.
+
+Two control planes, chosen by the SHAPE of `samp`:
+  - [B]    float32: per-row temperature only (the lean serving default —
+           no sort in the sampler's hot path)
+  - [B, 3] float32: per-row (temperature, top_p, top_k) — the engine's
+           sampling_controls mode. One descending sort serves both filters;
+           0 disables a control for that row. The whole row-state travels
+           as one array so every compiled program signature is unchanged.
 """
 
 from __future__ import annotations
@@ -11,11 +19,29 @@ import jax
 import jax.numpy as jnp
 
 
-def sample_tokens(logits, rng, temperature, top_k: int = 0, top_p: float = 0.0):
-    """logits: [B, V] float32; temperature: [B] float32 (0 => greedy);
-    top_k: static int (0 disables); top_p: static float (0 disables).
-    Returns ([B] int32 tokens, new rng)."""
+def temperature_of(samp):
+    """The per-row temperature vector from either control-plane shape."""
+    return samp if samp.ndim == 1 else samp[:, 0]
+
+
+def pack_controls(temperature, top_p, top_k):
+    """Host-side [K, 3] float32 row-control rows (see sample_tokens)."""
+    import numpy as np
+
+    return np.stack([
+        np.asarray(temperature, dtype=np.float32),
+        np.asarray(top_p, dtype=np.float32),
+        np.asarray(top_k, dtype=np.float32),
+    ], axis=1)
+
+
+def sample_tokens(logits, rng, samp, top_k: int = 0, top_p: float = 0.0):
+    """logits: [B, V] float32; samp: [B] temperatures or [B, 3] per-row
+    (temperature, top_p, top_k) controls (0 => disabled / greedy);
+    top_k / top_p: static engine-wide caps (0 disables), applied on top of
+    any per-row controls. Returns ([B] int32 tokens, new rng)."""
     B, V = logits.shape
+    temperature = temperature_of(samp)
     rng, sub = jax.random.split(rng)
 
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
@@ -34,6 +60,25 @@ def sample_tokens(logits, rng, temperature, top_k: int = 0, top_p: float = 0.0):
         cutoff_idx = jnp.sum(cumulative < top_p, axis=-1, keepdims=True)
         cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx, axis=-1)
         scaled = jnp.where(scaled < cutoff, -1e30, scaled)
+
+    if samp.ndim == 2:
+        top_p_row = samp[:, 1]
+        top_k_row = samp[:, 2]
+        # ONE descending sort serves both per-row filters; each filter is
+        # computed against the same (temperature-scaled) distribution, and
+        # a row's 0 disables that filter via the mask term
+        sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]
+        k_idx = jnp.clip(top_k_row.astype(jnp.int32) - 1, 0, V - 1)[:, None]
+        kth = jnp.take_along_axis(sorted_desc, k_idx, axis=-1)   # [B, 1]
+        scaled = jnp.where((top_k_row[:, None] > 0) & (scaled < kth),
+                           -1e30, scaled)
+        probs = jax.nn.softmax(sorted_desc, axis=-1)
+        cumulative = jnp.cumsum(probs, axis=-1)
+        cutoff_idx = jnp.sum(cumulative < top_p_row[:, None], axis=-1,
+                             keepdims=True)
+        cutoff = jnp.take_along_axis(sorted_desc, cutoff_idx, axis=-1)
+        scaled = jnp.where((top_p_row[:, None] > 0) & (scaled < cutoff),
+                           -1e30, scaled)
 
     sampled = jax.random.categorical(sub, scaled, axis=-1).astype(jnp.int32)
     tokens = jnp.where(temperature <= 0.0, greedy, sampled)

@@ -16,16 +16,23 @@ import pytest
 from gofr_tpu.logging import MockLogger
 from gofr_tpu.models.llama import LlamaConfig, llama_init
 from gofr_tpu.tpu.engine import LLMEngine
+from gofr_tpu.tpu.paging import PagedLLMEngine
 
 CFG = LlamaConfig.debug()
 
+# both engines serve the chunk path since r4: dense against live cache
+# rows, paged against bucket-sized job temps + a final page scatter
+ENGINES = [LLMEngine, PagedLLMEngine]
 
-def _make(chunk=0, **kw):
+
+def _make(chunk=0, cls=LLMEngine, **kw):
     params = llama_init(CFG, seed=0)
     defaults = dict(n_slots=4, max_seq_len=128, prefill_buckets=(8, 32),
                     decode_block_size=4, logger=MockLogger())
+    if cls is PagedLLMEngine:
+        defaults["page_size"] = 16
     defaults.update(kw)
-    eng = LLMEngine(params, CFG, chunk_prefill_tokens=chunk, **defaults)
+    eng = cls(params, CFG, chunk_prefill_tokens=chunk, **defaults)
     eng.start()
     return eng
 
@@ -39,7 +46,8 @@ PROMPTS = [
 ]
 
 
-def test_chunked_matches_fused_token_for_token():
+@pytest.mark.parametrize("cls", ENGINES)
+def test_chunked_matches_fused_token_for_token(cls):
     fused = _make(chunk=0)
     try:
         want = [fused.generate(p, max_new_tokens=8, temperature=0.0)
@@ -47,7 +55,7 @@ def test_chunked_matches_fused_token_for_token():
     finally:
         fused.stop()
 
-    chunked = _make(chunk=8)
+    chunked = _make(chunk=8, cls=cls)
     try:
         got = [chunked.generate(p, max_new_tokens=8, temperature=0.0)
                for p in PROMPTS]
@@ -56,11 +64,12 @@ def test_chunked_matches_fused_token_for_token():
     assert got == want
 
 
-def test_chunked_admission_during_active_decode():
+@pytest.mark.parametrize("cls", ENGINES)
+def test_chunked_admission_during_active_decode(cls):
     """A chunked admission lands while another request is mid-decode: the
-    decoding request's output must be untouched (parked positions keep the
-    interleaved lock-step junk out of the new prompt's range) and the new
-    request must match the fused engine."""
+    decoding request's output must be untouched (dense: parked positions;
+    paged: the reserved slot's zero table row diverts junk to the garbage
+    page) and the new request must match the fused engine."""
     fused = _make(chunk=0)
     try:
         want_long = fused.generate([5, 6, 7], max_new_tokens=40,
@@ -70,7 +79,7 @@ def test_chunked_admission_during_active_decode():
     finally:
         fused.stop()
 
-    eng = _make(chunk=8, decode_block_size=2)
+    eng = _make(chunk=8, decode_block_size=2, cls=cls)
     try:
         long_req = eng.submit([5, 6, 7], max_new_tokens=40, temperature=0.0)
         while long_req.generated < 4:   # ensure decode is genuinely running
@@ -107,13 +116,40 @@ def test_chunked_queue_wait_stamped_once():
         eng.stop()
 
 
-def test_paged_engine_rejects_chunking():
-    from gofr_tpu.tpu.paging import PagedLLMEngine
+def test_paged_chunked_releases_pages_and_q8_composes():
+    """Chunked admission over the INT8 pool (values+scales scatter once at
+    the final chunk), and page accounting: all pages return to the free
+    list after the chunked requests finish."""
+    import dataclasses
 
+    cfg_q8 = dataclasses.replace(CFG, kv_dtype="int8")
     params = llama_init(CFG, seed=0)
-    with pytest.raises(ValueError, match="not supported by the paged"):
-        PagedLLMEngine(params, CFG, n_slots=2, max_seq_len=64, page_size=8,
-                       chunk_prefill_tokens=8, logger=MockLogger())
+    eng = PagedLLMEngine(params, cfg_q8, n_slots=4, max_seq_len=128,
+                         prefill_buckets=(8, 32), decode_block_size=4,
+                         page_size=16, chunk_prefill_tokens=8,
+                         logger=MockLogger())
+    eng.start()
+    try:
+        out = [eng.submit(p, max_new_tokens=8, temperature=0.0)
+               for p in PROMPTS]
+        got = [r.result(timeout_s=300) for r in out]
+        assert all(len(t) == 8 for t in got)
+    finally:
+        eng.stop()
+    assert eng.allocator.used_pages == 0, "chunked admission leaked pages"
+
+
+def test_paged_chunk_warmup_compiles_variants():
+    eng = _make(chunk=8, cls=PagedLLMEngine)
+    try:
+        eng.warmup(grow=True)
+        names = list(eng.executor.cache_info())
+        assert any("llama-paged-chunk-8x1-b32" in n for n in names)
+        assert any("llama-paged-chunk-final-8x1-b32" in n for n in names)
+        # the fused program for the chunk-routed bucket is NOT warmed
+        assert not any("llama-paged-prefill-32x" in n for n in names)
+    finally:
+        eng.stop()
 
 
 def test_chunk_warmup_compiles_variants():

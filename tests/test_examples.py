@@ -438,3 +438,87 @@ def test_openai_server_min_tokens_gates_stop_strings():
         assert status == 400
     finally:
         app.shutdown()
+
+
+def test_openai_server_min_tokens_floor_survives_early_stream_end():
+    """A stream that dies (cancel/engine failure) before min_tokens tokens
+    arrive must NOT let the final stop-string scan truncate inside the
+    protected prefix: everything received is within the floor (ADVICE r3)."""
+    import queue as _queue
+
+    module = _load("openai-server")
+    app = module.build_app(config=_cfg(TPU_PLATFORM="cpu",
+                                       MODEL_PRESET="debug", WARMUP="false",
+                                       REQUEST_TIMEOUT="60"))
+    from gofr_tpu.tpu.engine import GenerationRequest
+
+    def fake_submit(prompt_tokens, **kwargs):
+        # a request whose stream yields "ab" then ends — far short of
+        # min_tokens, as after a client cancel or device loss
+        req = GenerationRequest(prompt_tokens, **kwargs)
+        for t in (ord("a"), ord("b")):
+            req.out_queue.put(t)
+        req.out_queue.put(None)
+        return req
+
+    app.engine.submit = fake_submit
+    app.start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{app.http_port}/v1/completions", method="POST",
+            data=json.dumps({"prompt": "xx", "max_tokens": 12,
+                             "min_tokens": 8, "stop": "a",
+                             "stream": True}).encode())
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            events = [line[6:] for line in resp.read().decode().splitlines()
+                      if line.startswith("data: ")]
+        assert events[-1] == "[DONE]"
+        parsed = [json.loads(e) for e in events[:-1]]
+        streamed = "".join(c["choices"][0].get("text") or "" for c in parsed)
+        # the stop string "a" sits INSIDE the min_tokens floor: protected
+        assert streamed == "ab"
+    finally:
+        app.shutdown()
+
+
+def test_openai_server_sampling_params_honored_or_rejected():
+    """top_p/top_k are HONORED (tiny top_p at temperature 1 == greedy:
+    one survivor per step); parameters the server cannot honor are 400s
+    when non-default, never silently ignored — but SDK-sent no-op
+    defaults (0.0 penalties) must pass."""
+    module = _load("openai-server")
+    app = module.build_app(config=_cfg(TPU_PLATFORM="cpu",
+                                       MODEL_PRESET="debug", WARMUP="false",
+                                       REQUEST_TIMEOUT="60"))
+    app.start()
+    try:
+        port = app.http_port
+        status, greedy = _call(port, "/v1/completions", "POST",
+                               {"prompt": "topx", "max_tokens": 8,
+                                "temperature": 0})
+        assert status == 201
+        status, trunc = _call(port, "/v1/completions", "POST",
+                              {"prompt": "topx", "max_tokens": 8,
+                               "temperature": 1.0, "top_p": 1e-4})
+        assert status == 201
+        assert trunc["choices"][0]["text"] == greedy["choices"][0]["text"]
+        status, trunc_k = _call(port, "/v1/completions", "POST",
+                                {"prompt": "topx", "max_tokens": 8,
+                                 "temperature": 1.0, "top_k": 1})
+        assert status == 201
+        assert trunc_k["choices"][0]["text"] == greedy["choices"][0]["text"]
+        # non-default unsupported params: honest 400s
+        for body in ({"frequency_penalty": 0.5}, {"presence_penalty": -1},
+                     {"logprobs": 5}, {"logit_bias": {"50": 10}},
+                     {"best_of": 3}, {"top_p": 0.0}, {"top_p": 1.7}):
+            status, _ = _call(port, "/v1/completions", "POST",
+                              {"prompt": "x", "max_tokens": 2, **body})
+            assert status == 400, f"{body} should be rejected"
+        # no-op defaults SDKs send unprompted: accepted
+        status, _ = _call(port, "/v1/completions", "POST",
+                          {"prompt": "x", "max_tokens": 2,
+                           "frequency_penalty": 0.0, "presence_penalty": 0,
+                           "logit_bias": {}, "best_of": 1, "top_p": 1.0})
+        assert status == 201
+    finally:
+        app.shutdown()

@@ -175,3 +175,37 @@ def test_q8_tp_scale_sharding_survives_growth():
                 "scale sharding dropped by growth"
     k0 = eng.k_cache[0]
     assert k0.sharding.shard_shape(k0.shape)[1] == 1
+
+
+def test_kernel_decode_rounds_incompatible_max_seq_len():
+    """decode_attn='kernel' reads the cache in min(512, S)-wide blocks; a
+    max_seq_len like 1000 would make the clamped grow target indivisible
+    and raise MID-SERVING. The engine must round the cap down at boot
+    (ADVICE r3 medium)."""
+    params = llama_init(CFG, seed=0)
+    cfg = dataclasses.replace(CFG, max_seq_len=8192, decode_attn="kernel")
+    eng = LLMEngine(params, cfg, n_slots=2, max_seq_len=1000,
+                    prefill_buckets=(8, 512))
+    assert eng.max_seq_len == 512
+    assert all(b <= 512 for b in eng.prefill_buckets)
+    # multiples of 512 and small caps pass through untouched
+    assert LLMEngine(params, cfg, n_slots=2, max_seq_len=1536,
+                     prefill_buckets=(8,)).max_seq_len == 1536
+    assert LLMEngine(params, cfg, n_slots=2, max_seq_len=300,
+                     prefill_buckets=(8,)).max_seq_len == 300
+    # the xla read has no block constraint: untouched
+    xla_cfg = dataclasses.replace(CFG, max_seq_len=8192)
+    assert LLMEngine(params, xla_cfg, n_slots=2, max_seq_len=1000,
+                     prefill_buckets=(8,)).max_seq_len == 1000
+
+
+def test_kernel_rounding_cannot_strand_requests():
+    """If the 512-rounding leaves NO prefill bucket under the cap, boot
+    must fail loudly — r4 review repro: requests were accepted (admission
+    limit fell back to max_seq_len-1) but no bucket could ever admit
+    them, hanging clients until timeout."""
+    params = llama_init(CFG, seed=0)
+    cfg = dataclasses.replace(CFG, max_seq_len=8192, decode_attn="kernel")
+    with pytest.raises(ValueError, match="no prefill bucket"):
+        LLMEngine(params, cfg, n_slots=2, max_seq_len=1000,
+                  prefill_buckets=(768,))

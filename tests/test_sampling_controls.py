@@ -1,0 +1,174 @@
+"""Per-request top_p / top_k: the [B, 3] row-control sampling plane.
+
+sampling_controls=True widens the engine's per-row sampling state from [B]
+temperatures to [B, 3] (temperature, top_p, top_k) — every program signature
+is unchanged (the state travels as one array), and a row's 0 disables that
+control. Key deterministic property used throughout: top_k=1 (or a
+vanishingly small top_p) at ANY temperature must reproduce greedy output
+exactly, because the truncated distribution has one survivor.
+"""
+
+import dataclasses
+
+import pytest
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gofr_tpu.models.llama import LlamaConfig, llama_init
+from gofr_tpu.tpu.engine import LLMEngine
+from gofr_tpu.tpu.paging import PagedLLMEngine
+from gofr_tpu.tpu.sampling import pack_controls, sample_tokens, temperature_of
+
+CFG = LlamaConfig.debug()
+PROMPTS = [[5, 6, 7, 8, 5, 6, 7, 8], [9, 8, 7], list(range(20, 50)), [11]]
+
+
+def test_sampler_per_row_top_k_one_is_greedy():
+    rng = jax.random.PRNGKey(0)
+    logits = jax.random.normal(jax.random.PRNGKey(1), (6, 64),
+                               dtype=jnp.float32)
+    greedy = jnp.argmax(logits, axis=-1)
+    samp = jnp.asarray(pack_controls(
+        temperature=[1.0] * 6, top_p=[0.0] * 6, top_k=[1] * 6))
+    toks, _ = sample_tokens(logits, rng, samp)
+    assert jnp.array_equal(toks, greedy)
+
+
+def test_sampler_per_row_tiny_top_p_is_greedy():
+    rng = jax.random.PRNGKey(0)
+    logits = jax.random.normal(jax.random.PRNGKey(2), (6, 64),
+                               dtype=jnp.float32) * 4.0
+    greedy = jnp.argmax(logits, axis=-1)
+    samp = jnp.asarray(pack_controls(
+        temperature=[0.9] * 6, top_p=[1e-4] * 6, top_k=[0] * 6))
+    toks, _ = sample_tokens(logits, rng, samp)
+    assert jnp.array_equal(toks, greedy)
+
+
+def test_sampler_rows_are_independent():
+    """One dispatch, mixed rows: greedy row, top_k=1 row, unrestricted
+    sampled row — each row's control applies to that row only."""
+    rng = jax.random.PRNGKey(3)
+    logits = jax.random.normal(jax.random.PRNGKey(4), (3, 256),
+                               dtype=jnp.float32)
+    greedy = jnp.argmax(logits, axis=-1)
+    samp = jnp.asarray(pack_controls(
+        temperature=[0.0, 1.0, 50.0],   # row 2: near-uniform sampling
+        top_p=[0.0, 0.0, 0.0],
+        top_k=[0, 1, 0]))
+    toks, _ = sample_tokens(logits, rng, samp)
+    assert toks[0] == greedy[0]
+    assert toks[1] == greedy[1]
+    # row 2 at temperature 50 over 256 logits: overwhelmingly unlikely to
+    # hit the argmax across several rng draws — prove it CAN differ
+    differed = False
+    r = rng
+    for _ in range(8):
+        t, r = sample_tokens(logits, r, samp)
+        differed = differed or int(t[2]) != int(greedy[2])
+    assert differed, "unrestricted sampled row never left the argmax"
+
+
+def test_temperature_of_both_shapes():
+    flat = jnp.asarray([0.0, 0.7])
+    wide = jnp.asarray(pack_controls([0.0, 0.7], [0.5, 0.0], [3, 0]))
+    assert jnp.array_equal(temperature_of(flat), flat)
+    assert jnp.array_equal(temperature_of(wide), flat)
+
+
+def _serve(cls=LLMEngine, controls=True, submits=None, **kw):
+    params = llama_init(CFG, seed=0)
+    if cls is PagedLLMEngine:
+        kw.setdefault("page_size", 16)
+    eng = cls(params, CFG, n_slots=4, max_seq_len=128,
+              prefill_buckets=(8, 32), decode_block_size=4,
+              sampling_controls=controls, **kw)
+    eng.start()
+    try:
+        reqs = [eng.submit(p, **(s or {"max_new_tokens": 10,
+                                      "temperature": 0.0}))
+                for p, s in zip(PROMPTS, submits or [None] * len(PROMPTS))]
+        return [r.result(timeout_s=300) for r in reqs]
+    finally:
+        eng.stop()
+
+
+def test_controls_engine_greedy_parity():
+    """Pure-greedy traffic must be identical with and without the widened
+    sampling state (the [B, 3] plane changes nothing for temperature 0)."""
+    assert _serve(controls=True) == _serve(controls=False)
+
+
+@pytest.mark.parametrize("cls", [LLMEngine, PagedLLMEngine])
+def test_top_k_one_matches_greedy_end_to_end(cls):
+    """temperature 1.0 + top_k=1 leaves one survivor per step: the served
+    tokens must equal the greedy run's token-for-token, on both engines."""
+    want = _serve(cls=cls, controls=False)
+    sub = [{"max_new_tokens": 10, "temperature": 1.0, "top_k": 1}
+           for _ in PROMPTS]
+    assert _serve(cls=cls, submits=sub) == want
+
+
+def test_tiny_top_p_matches_greedy_end_to_end():
+    want = _serve(controls=False)
+    sub = [{"max_new_tokens": 10, "temperature": 0.8, "top_p": 1e-4}
+           for _ in PROMPTS]
+    assert _serve(submits=sub) == want
+
+
+def test_speculative_composes_with_controls():
+    """Spec mode + sampling controls: greedy rows still match the plain
+    engine exactly (the verify's greedy-row rule reads temperature through
+    temperature_of)."""
+    params = llama_init(CFG, seed=0)
+    eng = LLMEngine(params, CFG, n_slots=4, max_seq_len=128,
+                    prefill_buckets=(8, 32), speculative_tokens=4,
+                    sampling_controls=True)
+    eng.start()
+    try:
+        reqs = [eng.submit(p, max_new_tokens=12, temperature=0.0)
+                for p in PROMPTS]
+        got = [r.result(timeout_s=300) for r in reqs]
+    finally:
+        eng.stop()
+    want = _serve(controls=False, submits=[
+        {"max_new_tokens": 12, "temperature": 0.0} for _ in PROMPTS])
+    assert got == want
+
+
+def test_submit_validation():
+    params = llama_init(CFG, seed=0)
+    eng = LLMEngine(params, CFG, n_slots=2, max_seq_len=64,
+                    prefill_buckets=(8,))
+    with pytest.raises(ValueError, match="sampling_controls"):
+        eng.submit([1, 2], top_p=0.5)
+    with pytest.raises(ValueError, match="sampling_controls"):
+        eng.submit([1, 2], top_k=5)
+    eng2 = LLMEngine(params, CFG, n_slots=2, max_seq_len=64,
+                     prefill_buckets=(8,), sampling_controls=True)
+    with pytest.raises(ValueError, match="top_p"):
+        eng2.submit([1, 2], top_p=1.5)
+    with pytest.raises(ValueError, match="top_k"):
+        eng2.submit([1, 2], top_k=-1)
+
+
+def test_paged_speculative_composes_with_controls():
+    """The exact OpenAI-server default stack: paged pool + speculation +
+    sampling controls. The verify program must run (r4 review repro: the
+    paged acceptance used a raw `temps <= 0.0` against [B, 3] controls and
+    crashed on the first proposed draft)."""
+    params = llama_init(CFG, seed=0)
+    eng = PagedLLMEngine(params, CFG, n_slots=4, max_seq_len=128,
+                         prefill_buckets=(8, 32), page_size=16,
+                         speculative_tokens=4, sampling_controls=True)
+    eng.start()
+    try:
+        reqs = [eng.submit(p, max_new_tokens=12, temperature=0.0)
+                for p in PROMPTS]
+        got = [r.result(timeout_s=300) for r in reqs]
+    finally:
+        eng.stop()
+    assert got == _serve(controls=False, submits=[
+        {"max_new_tokens": 12, "temperature": 0.0} for _ in PROMPTS])
