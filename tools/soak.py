@@ -1,0 +1,172 @@
+#!/usr/bin/env python
+"""Serving soak harness: sustained mixed traffic + cancels, zero-error gate.
+
+Reproduces the round-3 soak profiles as one committed command (VERDICT r3
+weak #4: "soak results are claims, not artifacts"):
+
+    python tools/soak.py mixed       # dense engine, chunked prefill
+    python tools/soak.py paged-int8  # paged pool, int8 pages + weights
+    python tools/soak.py spec        # speculative decoding (paged pool)
+    python tools/soak.py all         # the three in sequence
+    python tools/soak.py all --seconds 180 --threads 6
+
+Each profile boots an engine, runs N seconds of Poisson-arrival traffic
+mixing greedy/temperature, short/long prompts, streaming reads, and random
+mid-stream cancels, then drains and asserts the invariants that regress
+silently: zero unexpected errors, every request terminal, and (paged) zero
+leaked pages. Exits non-zero on any violation; prints one JSON line per
+profile.
+
+Platform: CPU by default (SOAK_PLATFORM=tpu runs on the chip — single-
+tenant tunnel discipline applies: nothing else may touch the TPU).
+Model: SOAK_PRESET=debug|llama1b (debug default; llama1b is the TPU
+profile the round-3 numbers used).
+"""
+
+import argparse
+import json
+import os
+import random
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _build(profile: str, preset: str):
+    import dataclasses
+
+    from gofr_tpu.models.llama import LlamaConfig, llama_init, quantize_weights
+    from gofr_tpu.tpu.engine import LLMEngine
+    from gofr_tpu.tpu.paging import PagedLLMEngine
+
+    cfg = {"debug": LlamaConfig.debug, "llama1b": LlamaConfig.llama1b}[preset]()
+    small = preset == "debug"
+    kw = dict(
+        n_slots=8 if small else 64,
+        max_seq_len=256 if small else 1024,
+        prefill_buckets=(16, 32, 64) if small else (64, 128, 256, 512),
+        decode_block_size=4 if small else 16,
+    )
+    if profile == "mixed":
+        cfg = dataclasses.replace(
+            cfg, attn_impl=cfg.attn_impl if small else "flash",
+            decode_attn="xla" if small else "kernel")
+        params = llama_init(cfg, seed=0)
+        return LLMEngine(params, cfg, chunk_prefill_tokens=16 if small else 64,
+                         **kw)
+    if profile == "paged-int8":
+        cfg = dataclasses.replace(cfg, kv_dtype="int8")
+        params = quantize_weights(llama_init(cfg, seed=0))
+        return PagedLLMEngine(params, cfg, page_size=16 if small else 128,
+                              **kw)
+    if profile == "spec":
+        params = llama_init(cfg, seed=0)
+        return PagedLLMEngine(params, cfg, page_size=16 if small else 128,
+                              speculative_tokens=4, **kw)
+    raise SystemExit(f"unknown profile {profile!r}")
+
+
+def _soak(engine, seconds: float, n_threads: int, vocab: int) -> dict:
+    stats = {"ok": 0, "cancelled": 0, "errors": 0, "tokens": 0}
+    errors = []
+    lock = threading.Lock()
+    stop_at = time.time() + seconds
+
+    def worker(idx: int) -> None:
+        rng = random.Random(1000 + idx)
+        while time.time() < stop_at:
+            periodic = rng.random() < 0.5
+            if periodic:  # self-repetitive: the speculative fast path
+                unit = [rng.randrange(1, vocab) for _ in range(3)]
+                prompt = (unit * 8)[:rng.choice([6, 12, 24, 40])]
+            else:
+                prompt = [rng.randrange(1, vocab)
+                          for _ in range(rng.choice([3, 9, 20, 45]))]
+            try:
+                req = engine.submit(
+                    prompt,
+                    max_new_tokens=rng.choice([4, 12, 32]),
+                    temperature=rng.choice([0.0, 0.0, 0.8]),
+                    priority=rng.choice([0, 0, 1]),
+                )
+                cancel_after = (rng.randrange(1, 6)
+                                if rng.random() < 0.25 else None)
+                got = 0
+                for _tok in req.stream(timeout_s=600):
+                    got += 1
+                    if cancel_after is not None and got >= cancel_after:
+                        req.cancel()
+                        with lock:
+                            stats["cancelled"] += 1
+                        break
+                else:
+                    with lock:
+                        stats["ok"] += 1
+                with lock:
+                    stats["tokens"] += got
+            except Exception as exc:  # noqa: BLE001 - the soak gate itself
+                with lock:
+                    stats["errors"] += 1
+                    errors.append(repr(exc))
+            time.sleep(rng.expovariate(8.0))
+
+    threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    stats["error_samples"] = errors[:5]
+    return stats
+
+
+def run_profile(profile: str, seconds: float, n_threads: int,
+                preset: str) -> bool:
+    engine = _build(profile, preset)
+    engine.start()
+    engine.warmup()
+    t0 = time.time()
+    try:
+        stats = _soak(engine, seconds, n_threads, engine.cfg.vocab_size)
+        drained = engine.drain(timeout_s=120)
+    finally:
+        engine.stop()
+    stats.update(profile=profile, preset=preset,
+                 seconds=round(time.time() - t0, 1), drained=drained)
+    ok = stats["errors"] == 0 and drained and stats["ok"] > 0
+    leaked = None
+    if hasattr(engine, "allocator"):
+        leaked = engine.allocator.used_pages
+        stats["leaked_pages"] = leaked
+        ok = ok and leaked == 0
+    stats["pass"] = ok
+    print(json.dumps(stats))
+    return ok
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("profile", nargs="?", default="all",
+                        choices=["mixed", "paged-int8", "spec", "all"])
+    parser.add_argument("--seconds", type=float, default=120.0)
+    parser.add_argument("--threads", type=int, default=4)
+    args = parser.parse_args()
+
+    platform = os.environ.get("SOAK_PLATFORM", "cpu")
+    if platform != "tpu":
+        import jax
+
+        jax.config.update("jax_platforms", platform)
+    preset = os.environ.get("SOAK_PRESET", "debug")
+
+    profiles = (["mixed", "paged-int8", "spec"] if args.profile == "all"
+                else [args.profile])
+    ok = all([run_profile(p, args.seconds, args.threads, preset)
+              for p in profiles])
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
