@@ -195,11 +195,15 @@ class _Record:
         # final parseable record
         self._lock = threading.Lock()
 
-    def update(self, value=None, rename_metric=None, **extras):
-        """rename_metric=(old, new) applies INSIDE the same locked emit as
-        the value, so no thread (the watchdog exits at arbitrary moments)
-        can ever observe the new name paired with the old value."""
+    def update(self, value=None, rename_metric=None, set_metric=None,
+               **extras):
+        """rename_metric=(old, new) / set_metric=name apply INSIDE the same
+        locked emit as the value, so no thread (the watchdog exits at
+        arbitrary moments) can ever observe the new name paired with the
+        old value."""
         with self._lock:
+            if set_metric is not None:
+                self.result["metric"] = set_metric
             if rename_metric is not None:
                 old, new = rename_metric
                 self.result["metric"] = self.result["metric"].replace(old, new)
@@ -622,6 +626,102 @@ def main() -> None:
         print(f"[bench] T2 failed (earlier results preserved): {exc}",
               file=sys.stderr)
         record.update(t2_error=f"{type(exc).__name__}: {exc}"[:200])
+
+    # ---- T3: the NORTH-STAR model — Llama-3-8B, int8 weights, one chip ----
+    # BASELINE config 4 names Llama-3-8B; its bf16 weights (~15 GiB) cannot
+    # fit one 16 GiB v5e chip at all, so this stage serves the int8-weight
+    # tree (llama_init_quantized, ~8 GiB, generated leaf-wise so the float
+    # tree never exists) with the int8 KV cache and Pallas kernel read. A
+    # valid measurement REPLACES the 1B headline — the target model's
+    # number is the round's number; the 1B results stay in extras.
+    try:
+        if full_run and _left() > 420:
+            if engine is not None:
+                engine.stop()
+                engine = None
+            params = None  # drop the 1B tree before the 8B init  # noqa: F841
+            import gc
+
+            gc.collect()
+            from gofr_tpu.models.llama import (llama_init_quantized,
+                                               params_nbytes)
+
+            cfg8 = dataclasses.replace(
+                LlamaConfig.llama3_8b(), attn_impl=cfg.attn_impl,
+                decode_attn="kernel", kv_dtype="int8")
+            t8 = time.time()
+            params8 = llama_init_quantized(cfg8, seed=0)
+            w_bytes = params_nbytes(params8)
+            print(f"[bench] T3 8B int8 weights: {w_bytes/2**30:.2f} GiB "
+                  f"materialized in {time.time()-t8:.1f}s", file=sys.stderr)
+            eng8 = LLMEngine(params8, cfg8, n_slots=64, max_seq_len=512,
+                             prefill_buckets=(16, 64, 128, 256),
+                             decode_block_size=8, pipeline_depth=2, seed=0,
+                             budget_bytes=budget or None, metrics=manager,
+                             executor=Executor(cache_dir=cache_dir or None))
+            eng8.start()
+            try:
+                eng8.warmup(grow=False)
+                print(f"[bench] T3 engine up: slots={eng8.n_slots} "
+                      f"seq={eng8.max_seq_len} "
+                      f"(init+warmup {time.time()-t8:.1f}s)", file=sys.stderr)
+                prompts8 = [rng.integers(1, cfg8.vocab_size, size=8).tolist()
+                            for _ in range(eng8.n_slots)]
+                tok8, tokens8, el8, ttfts8 = run_phase_throughput(
+                    eng8, prompts8, max_new, rounds=2)
+                per_step = (w_bytes
+                            + kv_cache_bytes(cfg8, eng8.n_slots,
+                                             eng8._cache_len, dtype="int8")
+                            + kv_scales_bytes(cfg8, eng8.n_slots,
+                                              eng8._cache_len))
+                roof8 = V5E_HBM_GBPS * 1e9 * eng8.n_slots / per_step
+                p50_8, p99_8 = _percentiles(ttfts8)
+                print(f"[bench] T3 8B decode: {tokens8} tok in {el8:.2f}s = "
+                      f"{tok8:.1f} tok/s (roofline {roof8:.0f}, "
+                      f"frac {tok8/roof8:.3f})", file=sys.stderr)
+                record.update(
+                    value=tok8,
+                    set_metric=(f"decode_tokens_per_sec_llama3_8b_int8w"
+                                f"_bs{eng8.n_slots}_1chip"),
+                    headline_model="llama3-8b int8-weights int8-kv kernel",
+                    llama1b_tok_s=round(best_tok_s, 1),
+                    t3_model_gib=round(w_bytes / 2**30, 2),
+                    t3_roofline_tok_s=round(roof8, 1),
+                    t3_roofline_frac=round(tok8 / roof8, 3),
+                    t3_cache_len=eng8._cache_len,
+                    t3_slots=eng8.n_slots,
+                    t3_ttft_burst_p50_ms=round(p50_8 * 1e3, 1))
+                # the config-4 pair is (tok/s, p50 TTFT at a FEASIBLE
+                # operating point): measure a moderate Poisson point on
+                # the target model and make it the headline TTFT
+                if _left() > 120:
+                    mix8 = _prompt_mix(rng, 2 * eng8.n_slots,
+                                       cfg8.vocab_size,
+                                       eng8.admission_limit)
+                    point = _latency_point(
+                        eng8, mix8, max_new, 0.3 * tok8 / max_new,
+                        duration_s=min(20.0, _left() - 60), rng=rng)
+                    print(f"[bench] T3 L @{point['rate_rps']}rps: "
+                          f"ttft p50={point['ttft_p50_ms']}ms "
+                          f"p99={point['ttft_p99_ms']}ms", file=sys.stderr)
+                    record.update(t3_ttft_moderate=point,
+                                  ttft_p50_ms=point["ttft_p50_ms"],
+                                  ttft_p99_ms=point["ttft_p99_ms"],
+                                  ttft_queue_wait_p50_ms=point[
+                                      "queue_wait_p50_ms"],
+                                  ttft_arrival_rps=point["rate_rps"])
+            finally:
+                try:
+                    eng8.stop()
+                except Exception:  # noqa: BLE001
+                    pass
+                engine = None
+        elif full_run:
+            record.update(t3_skipped="budget")
+    except Exception as exc:  # noqa: BLE001 - the 1B record stands
+        print(f"[bench] T3 failed (earlier results preserved): {exc}",
+              file=sys.stderr)
+        record.update(t3_error=f"{type(exc).__name__}: {exc}"[:200])
 
     if engine is not None:
         try:

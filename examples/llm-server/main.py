@@ -106,6 +106,11 @@ def build_engine(app: App, default_sampling_controls: bool = False) -> LLMEngine
         n_pages = app.config.get_int("N_PAGES", 0)
         if n_pages:
             paged_kw["n_pages"] = n_pages
+        # PREFIX_CACHE shares whole prompt-prefix pages between requests
+        # (system prompts re-prefill once, not per request). Default ON
+        # for fp pools; int8 pools don't support it yet
+        paged_kw["prefix_cache"] = app.config.get_bool(
+            "PREFIX_CACHE", kv_dtype != "int8")
     # HBM capacity plan: clamp (MAX_BATCH, MAX_SEQ_LEN) to the device budget
     # before boot instead of discovering RESOURCE_EXHAUSTED mid-serve.
     # Auto-detected from the device (0 on CPU backends = no plan);
@@ -156,11 +161,67 @@ def build_engine(app: App, default_sampling_controls: bool = False) -> LLMEngine
     return engine
 
 
+def build_generate_service(engine, tokenizer):
+    """Server-streaming gRPC twin of the SSE /generate endpoint: one
+    {"text": ...} message per decoded chunk, then a {"done": true}
+    summary — the same payload shapes the SSE stream sends, so a client
+    can consume either transport with one parser. Registered by main()
+    (reference parity: grpc.go registers streaming protoc services)."""
+    import time as _time
+
+    from gofr_tpu.grpcx import GenericService
+    from gofr_tpu.models.tokenizer import StreamingDecoder
+
+    def grpc_generate(ctx):
+        body = ctx.request.payload or {}
+        prompt = body.get("prompt")
+        if not isinstance(prompt, str) or not prompt:
+            raise ValueError("prompt must be a non-empty string")
+        # full parameter parity with the SSE /generate handler — a client
+        # switching transports must not silently lose its sampling or
+        # admission settings
+        request = engine.submit(
+            tokenizer.encode(prompt),
+            max_new_tokens=int(body.get("max_tokens", 64)),
+            temperature=float(body.get("temperature", 0.0)),
+            stop_tokens={tokenizer.EOS},
+            min_tokens=max(0, int(body.get("min_tokens", 0) or 0)),
+            priority=max(0, min(9, int(body.get("priority", 0) or 0))),
+            top_p=float(body.get("top_p", 0.0) or 0.0),
+            top_k=int(body.get("top_k", 0) or 0))
+
+        def stream():
+            decoder = StreamingDecoder(tokenizer)
+            count = 0
+            start = _time.time()
+            try:
+                for token in request.stream():
+                    count += 1
+                    text = decoder.push(token)
+                    if text:
+                        yield {"text": text}
+                tail = decoder.flush()
+                if tail:
+                    yield {"text": tail}
+                yield {"done": True, "tokens": count,
+                       "tok_per_s": round(
+                           count / max(_time.time() - start, 1e-6), 1)}
+            finally:
+                request.cancel()   # client disconnect frees the slot
+
+        return stream()
+
+    return GenericService("llm.Generator", {},
+                          stream_methods={"Generate": grpc_generate})
+
+
 def main() -> None:
     os.chdir(os.path.dirname(os.path.abspath(__file__)))
     app = App()
     engine = build_engine(app)
     tokenizer: ByteTokenizer = engine.tokenizer
+    # token streaming over gRPC rides the same engine (GRPC_PORT)
+    app.register_grpc_service(build_generate_service(engine, tokenizer))
 
     @app.post("/generate")
     def generate(ctx):
@@ -239,6 +300,9 @@ def main() -> None:
             out["pages"] = {"used": allocator.used_pages,
                             "free": allocator.free_pages,
                             "page_size": allocator.page_size}
+        prefix = getattr(engine, "prefix", None)
+        if prefix is not None:
+            out["prefix_cache"] = prefix.stats()
         return out
 
     app.run()

@@ -11,6 +11,13 @@ protobuf wire format (exercised end-to-end in tests/test_grpc_proto.py with
 protoc-generated stubs). Handlers receive a Context whose request carries
 the deserialized message — the same handler shape as HTTP. Objects exposing
 `__grpc_service_name__` and `__grpc_methods__` register identically.
+
+SERVER-STREAMING RPCs (reference grpc.go registers arbitrary protoc
+services, streaming included): `stream_methods` handlers return an
+ITERATOR of responses; each item is serialized and sent as one stream
+message — how token generation travels over gRPC with the same chunk
+payloads as the SSE surface (examples/llm-server registers one).
+GRPCClient.stream() is the consuming counterpart.
 """
 
 from __future__ import annotations
@@ -77,9 +84,14 @@ class GRPCRequest:
 class GenericService:
     def __init__(self, name: str, methods: Dict[str, Callable[[Context], Any]],
                  serializer: Optional[Callable[[Any], bytes]] = None,
-                 deserializer: Optional[Callable[[bytes], Any]] = None):
+                 deserializer: Optional[Callable[[bytes], Any]] = None,
+                 stream_methods: Optional[Dict[str, Callable[[Context], Any]]]
+                 = None):
         self.__grpc_service_name__ = name
         self.__grpc_methods__ = methods
+        # server-streaming: handler returns an iterator; each item goes
+        # through the serializer as one stream message
+        self.__grpc_stream_methods__ = stream_methods or {}
         self.serializer = serializer or (lambda obj: json.dumps(obj, default=str).encode())
         self.deserializer = deserializer or (lambda raw: json.loads(raw.decode()) if raw else {})
 
@@ -105,6 +117,13 @@ class GRPCServer:
         for method_name, fn in methods.items():
             handlers[method_name] = grpc.unary_unary_rpc_method_handler(
                 self._adapt(f"/{name}/{method_name}", fn, serializer),
+                request_deserializer=deserializer,
+                response_serializer=lambda b: b,
+            )
+        for method_name, fn in getattr(service, "__grpc_stream_methods__",
+                                       {}).items():
+            handlers[method_name] = grpc.unary_stream_rpc_method_handler(
+                self._adapt_stream(f"/{name}/{method_name}", fn, serializer),
                 request_deserializer=deserializer,
                 response_serializer=lambda b: b,
             )
@@ -140,6 +159,45 @@ class GRPCServer:
 
         return handle
 
+    def _adapt_stream(self, full_method: str, fn, serializer):
+        """Server-streaming twin of _adapt: the handler's return value is
+        iterated and each item serialized as one stream message. The RPC
+        log records total duration and message count at stream end; a
+        handler exception mid-stream aborts with INTERNAL (the recovery
+        interceptor posture — never a silent truncation)."""
+        def handle(payload, grpc_ctx):
+            start = time.time()
+            metadata = {k: v for k, v in (grpc_ctx.invocation_metadata() or [])}
+            request = GRPCRequest(payload, full_method, metadata)
+            span = None
+            if self.container.tracer is not None:
+                span = self.container.tracer.start_span(
+                    f"grpc {full_method}", traceparent=metadata.get("traceparent"))
+                request.span = span
+            ctx = Context(request=request, container=self.container)
+            status = "OK"
+            sent = 0
+            try:
+                for item in fn(ctx):
+                    yield serializer(item)
+                    sent += 1
+            except Exception as exc:  # noqa: BLE001 - recovery interceptor
+                status = "ERROR"
+                self.logger.errorf("grpc stream %s failed after %d messages: %s",
+                                   full_method, sent, exc)
+                grpc_ctx.abort(self._grpc.StatusCode.INTERNAL, str(exc))
+            finally:
+                duration_us = int((time.time() - start) * 1e6)
+                trace_id = span.trace_id if span else ""
+                self.logger.info(RPCLog(f"{full_method} [{sent} msgs]",
+                                        status, duration_us, trace_id))
+                if span is not None:
+                    span.set_attribute("grpc.stream_messages", sent)
+                    span.set_status(status == "OK")
+                    span.end()
+
+        return handle
+
     def start(self) -> None:
         bound = self._server.add_insecure_port(f"0.0.0.0:{self.port}")
         if self.port == 0:
@@ -167,6 +225,23 @@ class GRPCClient:
              serializer: Optional[Callable[[Any], bytes]] = None,
              deserializer: Optional[Callable[[bytes], Any]] = None) -> Any:
         fn = self.channel.unary_unary(
+            f"/{service}/{method}",
+            request_serializer=serializer or (
+                lambda obj: json.dumps(obj, default=str).encode()),
+            response_deserializer=deserializer or (
+                lambda raw: json.loads(raw.decode()) if raw else None),
+        )
+        md = list((metadata or {}).items())
+        return fn(payload, timeout=timeout_s, metadata=md)
+
+    def stream(self, service: str, method: str, payload: Any,
+               timeout_s: float = 30.0,
+               metadata: Optional[Dict[str, str]] = None,
+               serializer: Optional[Callable[[Any], bytes]] = None,
+               deserializer: Optional[Callable[[bytes], Any]] = None):
+        """Server-streaming call: yields deserialized messages as they
+        arrive (the gRPC twin of reading an SSE response line by line)."""
+        fn = self.channel.unary_stream(
             f"/{service}/{method}",
             request_serializer=serializer or (
                 lambda obj: json.dumps(obj, default=str).encode()),

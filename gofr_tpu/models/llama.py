@@ -1054,6 +1054,88 @@ def llama_verify_step_paged(params, cfg: LlamaConfig, tokens, drafts,
     return greedy, logits0, k_pool, v_pool
 
 
+def llama_prefill_paged_prefix(params, cfg: LlamaConfig, tokens, prefix_lens,
+                               lengths, k_pool, v_pool, table, project_last):
+    """Prefill ONLY a prompt's un-cached TAIL against the paged pool.
+
+    The prefix-cache hit path: each row's first `prefix_lens[k]` tokens
+    (a whole number of pages) are already in shared pages referenced by
+    its block table, so this forward computes K/V for the tail window
+    alone — prefill FLOPs and writes scale with the UNSHARED tail, which
+    is the entire point of prefix caching.
+
+    tokens: [K, T] tail token ids (row k's tail starts at absolute
+    position prefix_lens[k]); prefix_lens: [K] int32 multiples of the
+    page size; lengths: [K] FULL prompt lengths; k/v_pool:
+    [L, P, Hkv, dh, ps]; table: [K, NP] page ids (shared prefix pages
+    first, then the row's fresh pages); project_last: [K] within-window
+    index of each row's last prompt token.
+
+    Per layer: tail K/V scatter into their pages (pad positions past
+    lengths[k] divert to the garbage page), then the tail queries attend
+    the GATHERED pages ([K, Hkv, dh, NP*ps] contiguous rows, one pool
+    read per layer — the same shape trick as llama_verify_step_paged)
+    under the standard `j <= q_pos` mask, which covers the shared prefix
+    and the tail's own causal window in one rule.
+
+    Returns (last_logits [K, V] float32, k_pool, v_pool).
+    """
+    K, T = tokens.shape
+    H, Hkv, dh, G = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim, cfg.q_per_kv
+    ps = k_pool.shape[-1]
+    NP = table.shape[1]
+    S = NP * ps
+    pos_grid = prefix_lens[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
+    in_prompt = pos_grid < lengths[:, None]                     # [K, T]
+    # scatter rule (shared with _prefill_scatter_indices' semantics):
+    # token at absolute pos -> (table[k, pos // ps], pos % ps); pads -> 0
+    page_slot = jnp.clip(pos_grid // ps, 0, NP - 1)
+    page_ids = jnp.take_along_axis(table, page_slot, axis=1)    # [K, T]
+    page_ids = jnp.where(in_prompt, page_ids, jnp.int32(0))
+    offsets = pos_grid % ps
+    x = _embed(params, cfg, tokens)
+
+    def layer_body(l, state):
+        x, k_pool, v_pool = state
+        layer = jax.tree_util.tree_map(lambda w: w[l], params["layers"])
+        kp_l = jax.lax.dynamic_index_in_dim(k_pool, l, 0, keepdims=False)
+        vp_l = jax.lax.dynamic_index_in_dim(v_pool, l, 0, keepdims=False)
+        normed = rms_norm(x, layer["attn_norm"], cfg.rms_eps)
+        q = rope(_mm(normed, layer, "wq").reshape(K, T, H, dh),
+                 pos_grid, cfg.rope_theta)
+        k = rope(_mm(normed, layer, "wk").reshape(K, T, Hkv, dh),
+                 pos_grid, cfg.rope_theta)
+        v = _mm(normed, layer, "wv").reshape(K, T, Hkv, dh)
+        # advanced indices on pool dims 0+3 -> value shape [K, T, Hkv, dh]
+        kp_l = kp_l.at[page_ids, :, :, offsets].set(k)
+        vp_l = vp_l.at[page_ids, :, :, offsets].set(v)
+        k_rows = jnp.moveaxis(kp_l[table], 1, 3).reshape(K, Hkv, dh, S)
+        v_rows = jnp.moveaxis(vp_l[table], 1, 3).reshape(K, Hkv, dh, S)
+        qg = q.reshape(K, T, Hkv, G, dh)
+        scores = jnp.einsum("bthgd,bhds->bhgts", qg, k_rows,
+                            preferred_element_type=jnp.float32
+                            ) / math.sqrt(dh)
+        cache_pos = jnp.arange(S)[None, None, :]
+        visible = cache_pos <= pos_grid[:, :, None]             # [K, T, S]
+        scores = jnp.where(visible[:, None, None, :, :], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        attn = jnp.einsum("bhgts,bhds->bthgd", probs.astype(v_rows.dtype),
+                          v_rows,
+                          preferred_element_type=jnp.float32).astype(x.dtype)
+        x = x + _mm(attn.reshape(K, T, H * dh), layer, "wo")
+        x = x + _ffn_block(x, layer, cfg)
+        k_pool = jax.lax.dynamic_update_index_in_dim(k_pool, kp_l, l, 0)
+        v_pool = jax.lax.dynamic_update_index_in_dim(v_pool, vp_l, l, 0)
+        return x, k_pool, v_pool
+
+    x, k_pool, v_pool = jax.lax.fori_loop(
+        0, cfg.n_layers, layer_body, (x, k_pool, v_pool))
+    x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+    last = x[jnp.arange(K), project_last]                       # [K, D]
+    logits = _head(last, params)
+    return logits, k_pool, v_pool
+
+
 def _attention_block_nocache(x, layer, positions, cfg: LlamaConfig,
                              attn_fn=None):
     """Plain causal attention sublayer (no cache). x: [B, T, D] -> [B, T, D].

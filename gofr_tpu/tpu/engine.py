@@ -151,7 +151,9 @@ class _Slot:
         self.request: Optional[GenerationRequest] = None
         self.length = 0
         self.remaining = 0
-        self.pages: Optional[List[int]] = None  # paged engine: owned page ids
+        self.pages: Optional[List[int]] = None  # paged engine: owned page
+        # ids, table order (shared prefix pages first; _finish_slot asks
+        # the prefix cache which pages it owns)
         # chunked prefill in progress: the slot is RESERVED (its cache row
         # is being filled chunk by chunk) but not yet emitting — excluded
         # from the free list and from decode demux until the final chunk
@@ -1466,10 +1468,12 @@ class LLMEngine:
         if not taken:
             return
 
-        # group by prompt bucket, then split counts into powers of two
+        # group by admission bucket (the paged engine's prefix cache may
+        # shrink a request's window to its un-cached tail), then split
+        # counts into powers of two
         by_bucket: Dict[int, List[GenerationRequest]] = {}
         for request in taken:
-            bucket = next_bucket(len(request.prompt_tokens), self.prefill_buckets)
+            bucket = self._admission_bucket(request)
             by_bucket.setdefault(bucket, []).append(request)
 
         free_iter = iter(free)
@@ -1516,6 +1520,11 @@ class LLMEngine:
         self._obs.gauge("app_tpu_queue_depth", self._pending.qsize())
         self._obs.gauge("app_tpu_active_slots",
                         sum(1 for s in self.slots if s.active))
+
+    def _admission_bucket(self, request: GenerationRequest) -> int:
+        """The prefill bucket this request admits under. The paged engine
+        overrides it to the un-cached TAIL's bucket on a prefix hit."""
+        return next_bucket(len(request.prompt_tokens), self.prefill_buckets)
 
     def _prep_admission(self, bucket: int, batch: List[GenerationRequest]):
         """Host-side admission arrays shared by the dense and paged engines:

@@ -91,7 +91,8 @@ class PagedLLMEngine(LLMEngine):
     _plan_paged = True  # capacity plan without the dense-cache transients
 
     def __init__(self, params, cfg: LlamaConfig, *, page_size: int = 128,
-                 n_pages: Optional[int] = None, **kw):
+                 n_pages: Optional[int] = None, prefix_cache: bool = False,
+                 **kw):
         # chunked prefill runs against bucket-sized per-job TEMPS and
         # scatters into pages once at the final chunk (_chunk_fn_paged);
         # speculative verify gathers pages into contiguous rows per layer
@@ -100,8 +101,16 @@ class PagedLLMEngine(LLMEngine):
         # the dense engine (same reasons apply)
         self.page_size = page_size
         self._requested_pages = n_pages
+        # prefix_cache=True shares whole prompt-prefix pages between
+        # requests (refcounted, LRU-evicted back into the allocator) —
+        # see tpu/prefixcache.py. int8 pools are excluded for now: the
+        # prefix program's gathered-row read has no dequant fold yet
+        self._prefix_enabled = bool(prefix_cache)
         # set pre-super: _init_device_state runs inside super().__init__
         super().__init__(params, cfg, **kw)
+        if self._prefix_enabled and self._q8:
+            raise ValueError("prefix_cache with kv_dtype='int8' is not "
+                             "supported yet (gathered-row dequant read)")
 
     # -- device state ---------------------------------------------------------
     def _init_device_state(self) -> None:
@@ -115,6 +124,13 @@ class PagedLLMEngine(LLMEngine):
             self.n_slots * math.ceil(self.max_seq_len / ps) + 1)
         self.allocator = PageAllocator(n_pages, ps)
         self._reservations: Dict[int, List[int]] = {}
+        # prefix cache rebuilds with the pool: a device-state reset zeroes
+        # the pages, so every cached entry is invalid by construction
+        from .prefixcache import PrefixCache
+
+        self.prefix = (PrefixCache(ps)
+                       if getattr(self, "_prefix_enabled", False) else None)
+        self._prefix_hits: Dict[int, List[int]] = {}
         self._cache_len = self.max_seq_len  # admission_limit compatibility
         L, Hkv, dh = self.cfg.n_layers, self.cfg.n_kv_heads, self.cfg.head_dim
         dt = {"bfloat16": jnp.bfloat16, "float32": jnp.float32,
@@ -218,7 +234,30 @@ class PagedLLMEngine(LLMEngine):
     def _admission_ready(self, request: GenerationRequest) -> bool:
         if request.id in self._reservations:
             return True
-        pages = self.allocator.alloc(self._request_pages(request))
+        shared: List[int] = []
+        if self.prefix is not None:
+            if request.id not in self._prefix_hits:
+                hit = self.prefix.match(request.prompt_tokens)
+                if hit and self._tail_routes_to_chunk(request, hit):
+                    # the tail would still chunk: drop the hit NOW, before
+                    # the reservation is sized — deciding later would leave
+                    # the reservation short by the matched pages and scatter
+                    # prompt KV into the garbage page (r4 review). The
+                    # finished chunk job still inserts, so the NEXT
+                    # identical prefix admits tail-only
+                    for page_id in hit:
+                        self.prefix.unref(page_id)
+                    hit = []
+                self._prefix_hits[request.id] = hit
+            shared = self._prefix_hits[request.id]
+        need = self._request_pages(request) - len(shared)
+        pages = self.allocator.alloc(need)
+        if pages is None and self.prefix is not None:
+            # idle cache pages are reclaimable capacity: evict LRU entries
+            # into the free list and retry before parking the request
+            self.allocator.release(
+                self.prefix.evict(need - self.allocator.free_pages))
+            pages = self.allocator.alloc(need)
         if pages is None:
             self._obs.counter("app_tpu_page_waits_total")
             return False
@@ -229,10 +268,47 @@ class PagedLLMEngine(LLMEngine):
         pages = self._reservations.pop(request.id, None)
         if pages is not None:
             self.allocator.release(pages)
+        shared = self._prefix_hits.pop(request.id, None)
+        if shared:
+            for page_id in shared:
+                self.prefix.unref(page_id)
+
+    def _tail_bucket(self, request: GenerationRequest,
+                     shared: List[int]) -> int:
+        from .executor import next_bucket
+
+        tail = len(request.prompt_tokens) - len(shared) * self.page_size
+        return next_bucket(max(1, tail), self.prefill_buckets)
+
+    def _tail_routes_to_chunk(self, request: GenerationRequest,
+                              shared: List[int]) -> bool:
+        return bool(self.chunk_prefill_tokens
+                    and self._tail_bucket(request, shared)
+                    > self.chunk_prefill_tokens)
+
+    def _admission_bucket(self, request: GenerationRequest) -> int:
+        """On a prefix hit, the admission window is the un-cached TAIL
+        (chunk-routed hits were already dropped in _admission_ready,
+        before the reservation was sized)."""
+        if self.prefix is None:
+            return super()._admission_bucket(request)
+        shared = self._prefix_hits.get(request.id) or []
+        if not shared:
+            return super()._admission_bucket(request)
+        return self._tail_bucket(request, shared)
 
     def _finish_slot(self, slot) -> None:
         if slot.pages is not None:
-            self.allocator.release(slot.pages)
+            if self.prefix is not None:
+                keep = []
+                for page_id in slot.pages:
+                    if self.prefix.owns(page_id):
+                        self.prefix.unref(page_id)   # stays cache-resident
+                    else:
+                        keep.append(page_id)
+                self.allocator.release(keep)
+            else:
+                self.allocator.release(slot.pages)
             slot.pages = None
         super()._finish_slot(slot)
         self._obs.gauge("app_tpu_pages_used", self.allocator.used_pages)
@@ -253,6 +329,17 @@ class PagedLLMEngine(LLMEngine):
                                                   final=False)
                         self._chunk_program_paged(chunk, 1, bucket,
                                                   final=True)
+            if self.prefix is not None and self.prefill_buckets:
+                # the feature's headline case is the SECOND request with a
+                # shared system prompt: its tail admits at the smallest
+                # bucket against a table spanning the full prompt's pages.
+                # Warm that variant per bucket-width so the first hit
+                # doesn't stall the loop on a compile (r4 review)
+                tail_b = min(self.prefill_buckets)
+                for bucket in self.prefill_buckets:
+                    self._prefix_program(
+                        tail_b, 1,
+                        _pow2_at_least(self.allocator.pages_for(bucket)))
             # warm the table widths the first admissions will actually hit:
             # dispatch uses pow2(widest_pages + 1), so NP=1 never occurs
             warm_widths = set()
@@ -705,8 +792,10 @@ class PagedLLMEngine(LLMEngine):
 
     def _finish_chunk_job(self, job) -> None:
         super()._finish_chunk_job(job)
-        for slot_idx, request in zip(job["slots_idx"], job["batch"]):
-            self.slots[slot_idx].pages = self._reservations.pop(request.id)
+        # chunk-routed requests always dropped their hit (_admission_bucket)
+        # but their freshly-written pages still INSERT, so the next request
+        # with this prefix admits tail-only
+        self._assign_pages(job["slots_idx"], job["batch"])
 
     def _abort_chunk_job(self, job, exc) -> None:
         for request in job["batch"]:
@@ -760,6 +849,132 @@ class PagedLLMEngine(LLMEngine):
             drafts, lens)
         return out_tokens, n_emit
 
+    # -- prefix-cache prefill (tail-only admission) ---------------------------
+    def _prefix_fn(self, bucket: int, K: int, n_table: int):
+        cfg = self.cfg
+        jnp = self._jnp
+        top_k = self.top_k
+        from ..models.llama import llama_prefill_paged_prefix
+        from .sampling import sample_tokens
+
+        def prefill(params, k_pool, v_pool, ptokens, ptable, prefix_lens,
+                    slots, lengths, tokens, positions, temps, new_temps,
+                    rng):
+            """Tail-only K-way admission: rows' shared prefix pages are
+            already live in the pool; only the [K, bucket] tail window is
+            computed and written (llama_prefill_paged_prefix), then first
+            tokens sample and loop state splices exactly like the fused
+            path."""
+            k_pool, v_pool = _pin_standard_layout(k_pool, v_pool)
+            project_last = jnp.clip(lengths - prefix_lens - 1, 0,
+                                    bucket - 1)
+            last, k_pool, v_pool = llama_prefill_paged_prefix(
+                params, cfg, ptokens, prefix_lens, lengths, k_pool, v_pool,
+                ptable, project_last)
+            first, rng = sample_tokens(last, rng, new_temps, top_k=top_k)
+            tokens = tokens.at[slots].set(first)
+            positions = positions.at[slots].set(lengths)
+            temps = temps.at[slots].set(new_temps)
+            k_pool, v_pool = _pin_standard_layout(k_pool, v_pool)
+            return k_pool, v_pool, tokens, positions, temps, rng, first
+
+        return prefill
+
+    def _prefix_program(self, bucket: int, K: int, n_table: int):
+        jnp = self._jnp
+        args = (self.params, self.k_cache, self.v_cache,
+                jnp.zeros((K, bucket), dtype=jnp.int32),
+                jnp.zeros((K, n_table), dtype=jnp.int32),
+                jnp.zeros((K,), dtype=jnp.int32),
+                jnp.zeros((K,), dtype=jnp.int32),
+                jnp.ones((K,), dtype=jnp.int32),
+                self._tokens, self._positions, self._temps,
+                self._temps_init(K), self.rng)
+        return self.executor.compile(
+            f"llama-paged-prefix-{bucket}x{K}-NP{n_table}{self._id_tag}",
+            self._prefix_fn(bucket, K, n_table),
+            args, donate_argnums=(1, 2, 8, 9, 10))
+
+    def _dispatch_prefill_prefix(self, bucket: int, slots_idx: List[int],
+                                 batch: List[GenerationRequest],
+                                 hits: List[List[int]]) -> None:
+        jnp = self._jnp
+        ps = self.page_size
+        from .. import native
+
+        K = len(batch)
+        prefix_lens = np.asarray([len(h) * ps for h in hits],
+                                 dtype=np.int32)
+        lengths = np.asarray([len(r.prompt_tokens) for r in batch],
+                             dtype=np.int32)
+        tails = [r.prompt_tokens[len(h) * ps:]
+                 for r, h in zip(batch, hits)]
+        ptokens = native.pad_batch(tails, bucket)
+        if ptokens is None:
+            ptokens = np.zeros((K, bucket), dtype=np.int32)
+            for row, tail in enumerate(tails):
+                ptokens[row, :len(tail)] = tail
+        if self.sampling_controls:
+            from .sampling import pack_controls
+
+            new_temps = pack_controls([r.temperature for r in batch],
+                                      [r.top_p for r in batch],
+                                      [r.top_k for r in batch])
+        else:
+            new_temps = np.asarray([r.temperature for r in batch],
+                                   dtype=np.float32)
+        # table: shared prefix pages then the reservation's fresh pages,
+        # wide enough for every row's full PROMPT page span
+        n_table = _pow2_at_least(
+            max(self.allocator.pages_for(int(n)) for n in lengths))
+        ptable = np.zeros((K, n_table), dtype=np.int32)
+        for row, request in enumerate(batch):
+            pages = self._reservations.get(request.id)
+            if pages is None:  # direct submit path outside _admit (tests)
+                pages = self.allocator.alloc(
+                    self._request_pages(request) - len(hits[row]))
+                if pages is None:
+                    raise RuntimeError("page pool exhausted at dispatch")
+                self._reservations[request.id] = pages
+            combined = (hits[row] + pages)[:n_table]
+            ptable[row, :len(combined)] = combined
+
+        program = self._prefix_program(bucket, K, n_table)
+        try:
+            (self.k_cache, self.v_cache, self._tokens, self._positions,
+             self._temps, self.rng, first) = program(
+                self.params, self.k_cache, self.v_cache,
+                jnp.asarray(ptokens), jnp.asarray(ptable),
+                jnp.asarray(prefix_lens),
+                jnp.asarray(np.asarray(slots_idx, dtype=np.int32)),
+                jnp.asarray(lengths), self._tokens, self._positions,
+                self._temps, jnp.asarray(new_temps), self.rng)
+        except Exception as exc:
+            raise CacheLostError(
+                f"prefix prefill dispatch failed: {exc}") from exc
+
+        batch_id = next(self._batch_seq)
+        dspan = self._dispatch_span(
+            "tpu.prefill", batch_id,
+            **{"batch.size": K, "tpu.prefill_bucket": bucket,
+               "tpu.prefix_pages": int(prefix_lens.sum()) // ps})
+        self._bind_slots(slots_idx, batch, first, bucket, batch_id, dspan)
+        self._assign_pages(slots_idx, batch)
+
+    def _assign_pages(self, slots_idx: List[int],
+                      batch: List[GenerationRequest]) -> None:
+        """Move each request's pages onto its slot (shared prefix pages
+        first — table order) and register the freshly-written full prompt
+        pages in the prefix cache."""
+        for row, request in enumerate(batch):
+            fresh = self._reservations.pop(request.id)
+            shared = (self._prefix_hits.pop(request.id, None) or []
+                      if self.prefix is not None else [])
+            slot = self.slots[slots_idx[row]]
+            slot.pages = list(shared) + fresh
+            if self.prefix is not None:
+                self.prefix.insert(request.prompt_tokens, slot.pages)
+
     # -- dispatch -------------------------------------------------------------
     def _build_table(self) -> np.ndarray:
         """Block table for the current active slots, padded to a power-of-
@@ -775,6 +990,15 @@ class PagedLLMEngine(LLMEngine):
 
     def _dispatch_prefill(self, bucket: int, slots_idx: List[int],
                           batch: List[GenerationRequest]) -> None:
+        if self.prefix is not None:
+            hits = [self._prefix_hits.get(r.id) or [] for r in batch]
+            if any(hits):
+                # `bucket` is already the group's TAIL bucket
+                # (_admission_bucket); all-miss rows ride along with
+                # prefix_len 0
+                self._dispatch_prefill_prefix(bucket, slots_idx, batch,
+                                              hits)
+                return
         K = len(batch)
         jnp = self._jnp
         ptokens, lengths, new_temps = self._prep_admission(bucket, batch)
@@ -817,8 +1041,7 @@ class PagedLLMEngine(LLMEngine):
                                     **{"batch.size": K,
                                        "tpu.prefill_bucket": bucket})
         self._bind_slots(slots_idx, batch, first, bucket, batch_id, dspan)
-        for row, request in enumerate(batch):
-            self.slots[slots_idx[row]].pages = self._reservations.pop(request.id)
+        self._assign_pages(slots_idx, batch)
 
     def _dispatch_decode(self) -> None:
         import time as _time
@@ -864,4 +1087,5 @@ class PagedLLMEngine(LLMEngine):
         # allocator wholesale (super holds the state lock; only the loop
         # thread touches _reservations, so clearing here is safe)
         self._reservations.clear()
+        self._prefix_hits.clear()
         super()._reset_device_state(exc)

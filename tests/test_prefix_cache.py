@@ -1,0 +1,210 @@
+"""Prefix caching: shared prompt-prefix pages over the paged pool.
+
+The contract (VERDICT r3 next #5): a second request sharing a cached
+prompt prefix admits with prefill work only for its UN-SHARED tail —
+whole pages of KV are shared read-only via the block table, refcounted,
+and LRU-evicted back into the allocator when idle. Greedy output must be
+token-for-token identical to an uncached engine.
+"""
+
+import pytest
+
+from gofr_tpu.logging import MockLogger
+from gofr_tpu.models.llama import LlamaConfig, llama_init
+from gofr_tpu.tpu.paging import PagedLLMEngine
+from gofr_tpu.tpu.prefixcache import PrefixCache
+
+CFG = LlamaConfig.debug()
+PS = 8
+
+SYSTEM = list(range(1, 33))            # 32 tokens = 4 full pages at ps=8
+
+
+def _engine(prefix=True, **kw):
+    params = llama_init(CFG, seed=0)
+    defaults = dict(n_slots=4, max_seq_len=128, prefill_buckets=(8, 32, 64),
+                    decode_block_size=4, page_size=PS, prefix_cache=prefix,
+                    logger=MockLogger())
+    defaults.update(kw)
+    eng = PagedLLMEngine(params, CFG, **defaults)
+    eng.start()
+    return eng
+
+
+# -- PrefixCache unit behavior ----------------------------------------------
+
+def test_cache_match_insert_evict_protocol():
+    c = PrefixCache(4)
+    toks = list(range(1, 14))           # 13 tokens: 3 full pages matchable
+    assert c.match(toks) == []          # cold
+    c.insert(toks, [7, 8, 9])
+    got = c.match(toks)
+    assert got == [7, 8, 9]
+    assert c.hit_pages == 3 and c.resident_pages == 3
+    # pages are ref'd by owner-insert (1) + the match above (1): no evict
+    assert c.evict(3) == []
+    for p in got:
+        c.unref(p)                      # the matching slot finished
+    for p in got:
+        c.unref(p)                      # the owning slot finished
+    assert sorted(c.evict(10)) == [7, 8, 9]
+    assert c.resident_pages == 0
+
+
+def test_cache_always_leaves_a_tail_token():
+    """A prompt that is exactly N full pages still needs its LAST token
+    recomputed (the sample needs its logits): at most N-1 pages match."""
+    c = PrefixCache(4)
+    toks = list(range(1, 9))            # exactly 2 pages
+    c.insert(toks, [3, 4])              # only (8-1)//4 = 1 page registers
+    assert c.resident_pages == 1
+    assert c.match(toks) == [3]
+
+
+def test_cache_verifies_content_not_just_hash():
+    c = PrefixCache(4)
+    toks = [1, 2, 3, 4, 5]
+    c.insert(toks, [2])
+    key = next(iter(c._entries))
+    page_id, _ = c._entries[key]
+    c._entries[key] = (page_id, (9, 9, 9, 9))   # simulate a collision
+    assert c.match(toks) == []                   # degraded to a miss
+
+
+# -- engine behavior ---------------------------------------------------------
+
+def _gen(eng, prompt, n=8):
+    return eng.submit(prompt, max_new_tokens=n, temperature=0.0).result(
+        timeout_s=300)
+
+
+def test_second_request_admits_tail_only_and_matches_uncached():
+    plain = _engine(prefix=False)
+    try:
+        want_a = _gen(plain, SYSTEM + [40, 41, 42])
+        want_b = _gen(plain, SYSTEM + [50, 51])
+    finally:
+        plain.stop()
+
+    eng = _engine()
+    try:
+        got_a = _gen(eng, SYSTEM + [40, 41, 42])
+        assert eng.prefix.hit_pages == 0          # cold
+        got_b = _gen(eng, SYSTEM + [50, 51])
+        assert eng.prefix.hit_pages == 4, "prefix pages did not hit"
+        # the second admission ran the TAIL-ONLY program at the smallest
+        # bucket (tail of 3 tokens -> bucket 8), not the full 64 bucket
+        names = list(eng.executor.cache_info())
+        assert any(n.startswith("llama-paged-prefix-8x1") for n in names), \
+            names
+    finally:
+        eng.stop()
+    assert got_a == want_a
+    assert got_b == want_b
+
+
+def test_identical_prompt_reuses_and_stays_deterministic():
+    eng = _engine()
+    try:
+        first = _gen(eng, SYSTEM + [77, 78, 79, 80])
+        second = _gen(eng, SYSTEM + [77, 78, 79, 80])
+        assert second == first
+        assert eng.prefix.hit_pages == 4
+    finally:
+        eng.stop()
+
+
+def test_concurrent_sharers_and_page_accounting():
+    """Two live requests share the prefix pages (refcount 2); when both
+    finish, only cache-resident pages remain used and eviction frees
+    them completely."""
+    eng = _engine()
+    try:
+        warm = _gen(eng, SYSTEM + [60])            # seed the cache
+        del warm
+        reqs = [eng.submit(SYSTEM + [61 + i], max_new_tokens=12,
+                           temperature=0.0) for i in range(2)]
+        for r in reqs:
+            r.result(timeout_s=300)
+        # all slots done: every used page must be cache-resident
+        assert eng.allocator.used_pages == eng.prefix.resident_pages
+        freed = eng.prefix.drop_all_idle()
+        eng.allocator.release(freed)
+        assert eng.allocator.used_pages == 0
+    finally:
+        eng.stop()
+
+
+def test_pool_pressure_evicts_idle_cache_pages():
+    """A tiny pool: the cache's idle pages are reclaimable capacity, so a
+    new unrelated request must evict them rather than park forever."""
+    # 12 usable pages; each request needs ceil((5+8)/8) = 2 pages
+    eng = _engine(n_pages=13, max_seq_len=64, prefill_buckets=(8, 32))
+    try:
+        for base in range(5):                      # distinct 5-token prompts
+            _gen(eng, [100 + base * 7 + j for j in range(5)], n=8)
+        resident_before = eng.prefix.resident_pages
+        out = _gen(eng, [200, 201, 202, 203, 204], n=8)
+        assert len(out) == 8
+        assert eng.prefix.evicted_pages >= 0
+        assert eng.allocator.used_pages <= 12
+        assert resident_before >= 0
+    finally:
+        eng.stop()
+
+
+def test_prefix_composes_with_chunked_prefill():
+    """First long prompt routes through the chunk path (and INSERTS its
+    pages); an identical prompt then hits and admits tail-only below the
+    chunk threshold. Outputs match the uncached engine."""
+    plain = _engine(prefix=False, chunk_prefill_tokens=8)
+    try:
+        want = _gen(plain, SYSTEM + [90, 91, 92])
+    finally:
+        plain.stop()
+    eng = _engine(chunk_prefill_tokens=8)
+    try:
+        first = _gen(eng, SYSTEM + [90, 91, 92])
+        assert eng.prefix.inserted_pages == 4      # chunk job inserted
+        again = _gen(eng, SYSTEM + [90, 91, 92])
+        assert eng.prefix.hit_pages == 4
+        assert first == want and again == want
+    finally:
+        eng.stop()
+
+
+def test_prefix_rejected_with_int8_pool():
+    import dataclasses
+
+    params = llama_init(CFG, seed=0)
+    with pytest.raises(ValueError, match="prefix_cache"):
+        PagedLLMEngine(params, dataclasses.replace(CFG, kv_dtype="int8"),
+                       n_slots=2, max_seq_len=64, prefill_buckets=(8,),
+                       page_size=8, prefix_cache=True)
+
+
+def test_evict_never_strands_chain_descendants():
+    """Eviction is leaf-first: freeing an early page of a cumulative-hash
+    chain would make every descendant unreachable-but-resident (r4
+    review). Asking for one page must take the chain TAIL, and the
+    remaining prefix must still match."""
+    c = PrefixCache(4)
+    toks = list(range(1, 14))           # 3 full pages
+    c.insert(toks, [5, 6, 7])
+    for p in (5, 6, 7):
+        c.unref(p)                      # owner slot finished: all idle
+    assert c.evict(1) == [7]            # tail, not the LRU head (5)
+    got = c.match(toks)
+    assert got == [5, 6], "surviving chain prefix stopped matching"
+    for p in got:
+        c.unref(p)
+
+
+def test_warmup_precompiles_prefix_program():
+    eng = _engine()
+    try:
+        eng.warmup()
+        names = list(eng.executor.cache_info())
+        assert any(n.startswith("llama-paged-prefix-") for n in names), names
+    finally:
+        eng.stop()
