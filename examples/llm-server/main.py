@@ -107,10 +107,9 @@ def build_engine(app: App, default_sampling_controls: bool = False) -> LLMEngine
         if n_pages:
             paged_kw["n_pages"] = n_pages
         # PREFIX_CACHE shares whole prompt-prefix pages between requests
-        # (system prompts re-prefill once, not per request). Default ON
-        # for fp pools; int8 pools don't support it yet
-        paged_kw["prefix_cache"] = app.config.get_bool(
-            "PREFIX_CACHE", kv_dtype != "int8")
+        # (system prompts re-prefill once, not per request); int8 pools
+        # share their scale pages alongside
+        paged_kw["prefix_cache"] = app.config.get_bool("PREFIX_CACHE", True)
     # HBM capacity plan: clamp (MAX_BATCH, MAX_SEQ_LEN) to the device budget
     # before boot instead of discovering RESOURCE_EXHAUSTED mid-serve.
     # Auto-detected from the device (0 on CPU backends = no plan);

@@ -50,10 +50,10 @@ def _load_llm_server():
 build_engine = _load_llm_server().build_engine  # the llm-server's builder
 
 
-def main() -> None:
-    os.chdir(os.path.dirname(os.path.abspath(__file__)))
-    app = App()
+def build_app(**kw) -> App:
+    app = App(**kw)
     engine = build_engine(app)
+    app.engine = engine    # reachable for operators/tests
     tokenizer = engine.tokenizer
 
     @app.subscribe("generate.requests")
@@ -100,7 +100,12 @@ def main() -> None:
             "pubsub": ctx.container.pubsub.health_check().details,
         }
 
-    app.run()
+    return app
+
+
+def main() -> None:
+    os.chdir(os.path.dirname(os.path.abspath(__file__)))
+    build_app().run()
 
 
 if __name__ == "__main__":

@@ -1136,6 +1136,90 @@ def llama_prefill_paged_prefix(params, cfg: LlamaConfig, tokens, prefix_lens,
     return logits, k_pool, v_pool
 
 
+def llama_prefill_paged_prefix_q8(params, cfg: LlamaConfig, tokens,
+                                  prefix_lens, lengths, k_pool, v_pool,
+                                  ks_pool, vs_pool, table, project_last):
+    """llama_prefill_paged_prefix over INT8 pools with per-token scales.
+
+    MIRRORS the fp variant with quantized storage: the tail's K/V quantize
+    on write (so the pages hold exactly what later decode reads), then the
+    gathered rows dequantize [K, Hkv, dh, NP*ps] for the tail window's
+    attention — prefix pages keep the DONOR's quantization (no requantize
+    drift), the same posture as the dense engine's chunked-q8 path.
+
+    k/v_pool: [L, P, Hkv, dh, ps] int8; ks/vs_pool: [L, P, Hkv, ps] f32.
+    Returns (last_logits [K, V] f32, k_pool, v_pool, ks_pool, vs_pool).
+    """
+    from ..ops.decode_attention import quantize_kv
+
+    K, T = tokens.shape
+    H, Hkv, dh, G = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim, cfg.q_per_kv
+    ps = k_pool.shape[-1]
+    NP = table.shape[1]
+    S = NP * ps
+    dt = _np_dtype(cfg.dtype)
+    pos_grid = prefix_lens[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
+    in_prompt = pos_grid < lengths[:, None]
+    page_slot = jnp.clip(pos_grid // ps, 0, NP - 1)
+    page_ids = jnp.take_along_axis(table, page_slot, axis=1)
+    page_ids = jnp.where(in_prompt, page_ids, jnp.int32(0))
+    offsets = pos_grid % ps
+    x = _embed(params, cfg, tokens)
+
+    def layer_body(l, state):
+        x, k_pool, v_pool, ks_pool, vs_pool = state
+        layer = jax.tree_util.tree_map(lambda w: w[l], params["layers"])
+        kp_l = jax.lax.dynamic_index_in_dim(k_pool, l, 0, keepdims=False)
+        vp_l = jax.lax.dynamic_index_in_dim(v_pool, l, 0, keepdims=False)
+        ksp_l = jax.lax.dynamic_index_in_dim(ks_pool, l, 0, keepdims=False)
+        vsp_l = jax.lax.dynamic_index_in_dim(vs_pool, l, 0, keepdims=False)
+        normed = rms_norm(x, layer["attn_norm"], cfg.rms_eps)
+        q = rope(_mm(normed, layer, "wq").reshape(K, T, H, dh),
+                 pos_grid, cfg.rope_theta)
+        k = rope(_mm(normed, layer, "wk").reshape(K, T, Hkv, dh),
+                 pos_grid, cfg.rope_theta)
+        v = _mm(normed, layer, "wv").reshape(K, T, Hkv, dh)
+        k8, ks = quantize_kv(k, axis=-1)           # [K,T,Hkv,dh], [K,T,Hkv]
+        v8, vs = quantize_kv(v, axis=-1)
+        kp_l = kp_l.at[page_ids, :, :, offsets].set(k8)
+        vp_l = vp_l.at[page_ids, :, :, offsets].set(v8)
+        ksp_l = ksp_l.at[page_ids, :, offsets].set(ks)
+        vsp_l = vsp_l.at[page_ids, :, offsets].set(vs)
+        k_rows = jnp.moveaxis(kp_l[table], 1, 3).reshape(K, Hkv, dh, S)
+        v_rows = jnp.moveaxis(vp_l[table], 1, 3).reshape(K, Hkv, dh, S)
+        ks_rows = jnp.moveaxis(ksp_l[table], 1, 2).reshape(K, Hkv, S)
+        vs_rows = jnp.moveaxis(vsp_l[table], 1, 2).reshape(K, Hkv, S)
+        k_deq = (k_rows.astype(jnp.float32)
+                 * ks_rows[:, :, None, :]).astype(dt)
+        v_deq = (v_rows.astype(jnp.float32)
+                 * vs_rows[:, :, None, :]).astype(dt)
+        qg = q.reshape(K, T, Hkv, G, dh)
+        scores = jnp.einsum("bthgd,bhds->bhgts", qg, k_deq,
+                            preferred_element_type=jnp.float32
+                            ) / math.sqrt(dh)
+        cache_pos = jnp.arange(S)[None, None, :]
+        visible = cache_pos <= pos_grid[:, :, None]
+        scores = jnp.where(visible[:, None, None, :, :], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        attn = jnp.einsum("bhgts,bhds->bthgd", probs.astype(v_deq.dtype),
+                          v_deq,
+                          preferred_element_type=jnp.float32).astype(x.dtype)
+        x = x + _mm(attn.reshape(K, T, H * dh), layer, "wo")
+        x = x + _ffn_block(x, layer, cfg)
+        k_pool = jax.lax.dynamic_update_index_in_dim(k_pool, kp_l, l, 0)
+        v_pool = jax.lax.dynamic_update_index_in_dim(v_pool, vp_l, l, 0)
+        ks_pool = jax.lax.dynamic_update_index_in_dim(ks_pool, ksp_l, l, 0)
+        vs_pool = jax.lax.dynamic_update_index_in_dim(vs_pool, vsp_l, l, 0)
+        return x, k_pool, v_pool, ks_pool, vs_pool
+
+    x, k_pool, v_pool, ks_pool, vs_pool = jax.lax.fori_loop(
+        0, cfg.n_layers, layer_body, (x, k_pool, v_pool, ks_pool, vs_pool))
+    x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+    last = x[jnp.arange(K), project_last]
+    logits = _head(last, params)
+    return logits, k_pool, v_pool, ks_pool, vs_pool
+
+
 def _attention_block_nocache(x, layer, positions, cfg: LlamaConfig,
                              attn_fn=None):
     """Plain causal attention sublayer (no cache). x: [B, T, D] -> [B, T, D].

@@ -103,14 +103,11 @@ class PagedLLMEngine(LLMEngine):
         self._requested_pages = n_pages
         # prefix_cache=True shares whole prompt-prefix pages between
         # requests (refcounted, LRU-evicted back into the allocator) —
-        # see tpu/prefixcache.py. int8 pools are excluded for now: the
-        # prefix program's gathered-row read has no dequant fold yet
+        # see tpu/prefixcache.py. int8 pools share scales alongside values
+        # (the prefix program's gathered read dequantizes per page)
         self._prefix_enabled = bool(prefix_cache)
         # set pre-super: _init_device_state runs inside super().__init__
         super().__init__(params, cfg, **kw)
-        if self._prefix_enabled and self._q8:
-            raise ValueError("prefix_cache with kv_dtype='int8' is not "
-                             "supported yet (gathered-row dequant read)")
 
     # -- device state ---------------------------------------------------------
     def _init_device_state(self) -> None:
@@ -880,16 +877,54 @@ class PagedLLMEngine(LLMEngine):
 
         return prefill
 
+    def _prefix_fn_q8(self, bucket: int, K: int, n_table: int):
+        """MIRRORS _prefix_fn over int8 pools + scale pools (the tail
+        quantizes on write; the gathered read dequantizes — see
+        llama_prefill_paged_prefix_q8)."""
+        cfg = self.cfg
+        jnp = self._jnp
+        top_k = self.top_k
+        from ..models.llama import llama_prefill_paged_prefix_q8
+        from .sampling import sample_tokens
+
+        def prefill(params, k_pool, v_pool, k_scale, v_scale, ptokens,
+                    ptable, prefix_lens, slots, lengths, tokens, positions,
+                    temps, new_temps, rng):
+            k_pool, v_pool = _pin_standard_layout(k_pool, v_pool)
+            project_last = jnp.clip(lengths - prefix_lens - 1, 0,
+                                    bucket - 1)
+            (last, k_pool, v_pool, k_scale,
+             v_scale) = llama_prefill_paged_prefix_q8(
+                params, cfg, ptokens, prefix_lens, lengths, k_pool, v_pool,
+                k_scale, v_scale, ptable, project_last)
+            first, rng = sample_tokens(last, rng, new_temps, top_k=top_k)
+            tokens = tokens.at[slots].set(first)
+            positions = positions.at[slots].set(lengths)
+            temps = temps.at[slots].set(new_temps)
+            k_pool, v_pool = _pin_standard_layout(k_pool, v_pool)
+            return (k_pool, v_pool, k_scale, v_scale, tokens, positions,
+                    temps, rng, first)
+
+        return prefill
+
     def _prefix_program(self, bucket: int, K: int, n_table: int):
         jnp = self._jnp
-        args = (self.params, self.k_cache, self.v_cache,
-                jnp.zeros((K, bucket), dtype=jnp.int32),
-                jnp.zeros((K, n_table), dtype=jnp.int32),
-                jnp.zeros((K,), dtype=jnp.int32),
-                jnp.zeros((K,), dtype=jnp.int32),
-                jnp.ones((K,), dtype=jnp.int32),
-                self._tokens, self._positions, self._temps,
-                self._temps_init(K), self.rng)
+        common = (jnp.zeros((K, bucket), dtype=jnp.int32),
+                  jnp.zeros((K, n_table), dtype=jnp.int32),
+                  jnp.zeros((K,), dtype=jnp.int32),
+                  jnp.zeros((K,), dtype=jnp.int32),
+                  jnp.ones((K,), dtype=jnp.int32),
+                  self._tokens, self._positions, self._temps,
+                  self._temps_init(K), self.rng)
+        if self._q8:
+            args = (self.params, self.k_cache, self.v_cache, self.k_scale,
+                    self.v_scale, *common)
+            return self.executor.compile(
+                f"llama-paged-prefix-q8-{bucket}x{K}-NP{n_table}"
+                f"{self._id_tag}",
+                self._prefix_fn_q8(bucket, K, n_table),
+                args, donate_argnums=(1, 2, 3, 4, 10, 11, 12))
+        args = (self.params, self.k_cache, self.v_cache, *common)
         return self.executor.compile(
             f"llama-paged-prefix-{bucket}x{K}-NP{n_table}{self._id_tag}",
             self._prefix_fn(bucket, K, n_table),
@@ -941,14 +976,25 @@ class PagedLLMEngine(LLMEngine):
 
         program = self._prefix_program(bucket, K, n_table)
         try:
-            (self.k_cache, self.v_cache, self._tokens, self._positions,
-             self._temps, self.rng, first) = program(
-                self.params, self.k_cache, self.v_cache,
-                jnp.asarray(ptokens), jnp.asarray(ptable),
-                jnp.asarray(prefix_lens),
-                jnp.asarray(np.asarray(slots_idx, dtype=np.int32)),
-                jnp.asarray(lengths), self._tokens, self._positions,
-                self._temps, jnp.asarray(new_temps), self.rng)
+            if self._q8:
+                (self.k_cache, self.v_cache, self.k_scale, self.v_scale,
+                 self._tokens, self._positions, self._temps, self.rng,
+                 first) = program(
+                    self.params, self.k_cache, self.v_cache, self.k_scale,
+                    self.v_scale, jnp.asarray(ptokens), jnp.asarray(ptable),
+                    jnp.asarray(prefix_lens),
+                    jnp.asarray(np.asarray(slots_idx, dtype=np.int32)),
+                    jnp.asarray(lengths), self._tokens, self._positions,
+                    self._temps, jnp.asarray(new_temps), self.rng)
+            else:
+                (self.k_cache, self.v_cache, self._tokens, self._positions,
+                 self._temps, self.rng, first) = program(
+                    self.params, self.k_cache, self.v_cache,
+                    jnp.asarray(ptokens), jnp.asarray(ptable),
+                    jnp.asarray(prefix_lens),
+                    jnp.asarray(np.asarray(slots_idx, dtype=np.int32)),
+                    jnp.asarray(lengths), self._tokens, self._positions,
+                    self._temps, jnp.asarray(new_temps), self.rng)
         except Exception as exc:
             raise CacheLostError(
                 f"prefix prefill dispatch failed: {exc}") from exc

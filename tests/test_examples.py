@@ -522,3 +522,57 @@ def test_openai_server_sampling_params_honored_or_rejected():
         assert status == 201
     finally:
         app.shutdown()
+
+
+def test_pubsub_worker_tp_sharded_end_to_end():
+    """BASELINE config 5's full composition in ONE flow: durable broker
+    ingress -> TENSOR-PARALLEL sharded engine (tp mesh over the virtual
+    devices) -> result published back to the broker — with generated
+    tokens identical to a single-device engine (VERDICT r3 weak #7).
+    tp=2: the debug preset's 2 KV heads allow one whole head per shard."""
+    import tempfile
+
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device virtual mesh")
+
+    with tempfile.TemporaryDirectory() as broker_dir:
+        def run(tp):
+            module = _load("pubsub-worker")
+            app = module.build_app(config=_cfg(
+                TPU_PLATFORM="cpu", MODEL_PRESET="debug", WARMUP="false",
+                PUBSUB_BACKEND="file", PUBSUB_DIR=broker_dir,
+                TP_SHARDS=str(tp), PAGED="false", REQUEST_TIMEOUT="120"))
+            app.start()
+            try:
+                broker = app.container.pubsub
+                for i in range(3):
+                    broker.publish("generate.requests", json.dumps(
+                        {"id": f"job-{tp}-{i}", "prompt": f"hello {i}",
+                         "max_tokens": 8, "temperature": 0}).encode())
+                results = {}
+                import time as _t
+                deadline = _t.time() + 240
+                while len(results) < 3 and _t.time() < deadline:
+                    msg = broker.subscribe("generate.results",
+                                           group=f"reader{tp}", timeout_s=5)
+                    if msg is not None:
+                        body = json.loads(msg.value)
+                        # the broker dir is shared between the two runs and
+                        # a fresh group replays from offset 0: keep ONLY
+                        # this run's results or the comparison is vacuous
+                        if str(body["id"]).startswith(f"job-{tp}-"):
+                            results[body["id"]] = body
+                        msg.commit()
+                assert len(results) == 3, f"only {len(results)} results"
+                status, stats = _call(app.http_port, "/stats")
+                assert status == 200 and "pubsub" in stats["data"]
+                return {k.split("-")[-1]: v["text"]
+                        for k, v in results.items()}
+            finally:
+                app.shutdown()
+
+        sharded = run(2)
+        single = run(1)
+    assert sharded == single, "tp broker flow diverged from single-device"

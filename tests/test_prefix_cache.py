@@ -173,14 +173,38 @@ def test_prefix_composes_with_chunked_prefill():
         eng.stop()
 
 
-def test_prefix_rejected_with_int8_pool():
+def test_prefix_composes_with_int8_pool():
+    """int8 pools share scale pages alongside value pages: the hit path
+    dequantizes the gathered rows (donor quantization preserved) and the
+    tail quantizes on write. Tokens may flip at near-ties vs the uncached
+    q8 engine (different read precisions for the prefix), so the contract
+    is lengths + determinism + bulk agreement + a real hit."""
     import dataclasses
 
-    params = llama_init(CFG, seed=0)
-    with pytest.raises(ValueError, match="prefix_cache"):
-        PagedLLMEngine(params, dataclasses.replace(CFG, kv_dtype="int8"),
-                       n_slots=2, max_seq_len=64, prefill_buckets=(8,),
-                       page_size=8, prefix_cache=True)
+    cfg_q8 = dataclasses.replace(CFG, kv_dtype="int8")
+
+    def serve(prefix):
+        params = llama_init(CFG, seed=0)
+        eng = PagedLLMEngine(params, cfg_q8, n_slots=4, max_seq_len=128,
+                             prefill_buckets=(8, 32, 64), page_size=PS,
+                             prefix_cache=prefix, logger=MockLogger())
+        eng.start()
+        try:
+            outs = [_gen(eng, SYSTEM + [40, 41, 42]),
+                    _gen(eng, SYSTEM + [50, 51])]
+            hits = eng.prefix.hit_pages if eng.prefix else 0
+            return outs, hits
+        finally:
+            eng.stop()
+
+    want, _ = serve(prefix=False)
+    got, hits = serve(prefix=True)
+    assert hits == 4, "int8 prefix pages did not hit"
+    assert [len(t) for t in got] == [len(t) for t in want]
+    assert got == serve(prefix=True)[0]          # deterministic
+    total = sum(len(t) for t in want)
+    agree = sum(a == b for w, g in zip(want, got) for a, b in zip(w, g))
+    assert agree / total > 0.6, f"only {agree}/{total} tokens agree"
 
 
 def test_evict_never_strands_chain_descendants():
