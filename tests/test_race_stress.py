@@ -106,16 +106,18 @@ def test_broker_concurrent_publish_consume_no_loss_no_dup():
 
 
 def _engine_submit_cancel_stress(engine_kwargs, prompts, max_new,
-                                 n_threads, rounds, cancel_mod):
+                                 n_threads, rounds, cancel_mod,
+                                 cls=None, on_done=None):
     """Shared body: many client threads submitting/streaming/cancelling
     against one engine — every request either completes with its own
-    deterministic tokens or raises cleanly; no cross-request leakage."""
+    deterministic tokens or raises cleanly; no cross-request leakage.
+    on_done(engine) runs after the hammer, before stop (leak gates)."""
     from gofr_tpu.models.llama import LlamaConfig, llama_init
     from gofr_tpu.tpu.engine import LLMEngine
 
     cfg = LlamaConfig.debug()
-    eng = LLMEngine(llama_init(cfg, seed=0), cfg, logger=MockLogger(),
-                    **engine_kwargs)
+    eng = (cls or LLMEngine)(llama_init(cfg, seed=0), cfg,
+                             logger=MockLogger(), **engine_kwargs)
     eng.start()
     try:
         golden = {i: eng.generate(p, max_new_tokens=max_new, temperature=0.0)
@@ -138,6 +140,8 @@ def _engine_submit_cancel_stress(engine_kwargs, prompts, max_new,
                         f"cross-request leakage for {i}"
 
         _hammer(n_threads, work)
+        if on_done is not None:
+            on_done(eng)
     finally:
         eng.stop()
 
@@ -229,3 +233,28 @@ def test_drain_races_concurrent_submitters():
     # drain cut an active request short
     assert all(o == 4 for o in outcomes if isinstance(o, int)), outcomes
     assert outcomes, "no submitter ever ran"
+
+
+def test_prefix_cache_engine_concurrent_submit_cancel():
+    """Prefix-cache bookkeeping (match refs, owner-insert, leaf-first
+    eviction under pool pressure, unref at finish AND at cancel-abort)
+    hammered by concurrent clients sharing a 2-page prompt prefix over a
+    deliberately small pool. Gate: after the hammer, dropping idle cache
+    pages leaves ZERO used pages — any refcount imbalance leaks."""
+    from gofr_tpu.tpu.paging import PagedLLMEngine
+
+    base = list(range(1, 17))             # 16 tokens = 2 full pages at ps=8
+
+    def assert_no_leaks(eng):
+        freed = eng.prefix.drop_all_idle()
+        eng.allocator.release(freed)
+        assert eng.allocator.used_pages == 0, \
+            f"{eng.allocator.used_pages} pages leaked (refs stuck)"
+        assert eng.prefix.hit_pages > 0, "stress never exercised a hit"
+
+    _engine_submit_cancel_stress(
+        dict(n_slots=4, max_seq_len=64, prefill_buckets=(8, 32),
+             page_size=8, prefix_cache=True, n_pages=21),
+        prompts={i: base + [30 + i] for i in range(6)},
+        max_new=6, n_threads=10, rounds=4, cancel_mod=3,
+        cls=PagedLLMEngine, on_done=assert_no_leaks)

@@ -60,7 +60,7 @@ def _build(profile: str, preset: str):
         cfg = dataclasses.replace(cfg, kv_dtype="int8")
         params = quantize_weights(llama_init(cfg, seed=0))
         return PagedLLMEngine(params, cfg, page_size=16 if small else 128,
-                              **kw)
+                              prefix_cache=True, **kw)
     if profile == "spec":
         params = llama_init(cfg, seed=0)
         return PagedLLMEngine(params, cfg, page_size=16 if small else 128,
@@ -138,6 +138,13 @@ def run_profile(profile: str, seconds: float, n_threads: int,
     ok = stats["errors"] == 0 and drained and stats["ok"] > 0
     leaked = None
     if hasattr(engine, "allocator"):
+        prefix = getattr(engine, "prefix", None)
+        if prefix is not None:
+            # cache-resident pages are not leaks: after the drain every
+            # ref must be released, so dropping idle entries frees ALL of
+            # them — anything left is a refcount leak
+            stats["prefix_cache"] = prefix.stats()
+            engine.allocator.release(prefix.drop_all_idle())
         leaked = engine.allocator.used_pages
         stats["leaked_pages"] = leaked
         ok = ok and leaked == 0
