@@ -232,3 +232,34 @@ def test_warmup_precompiles_prefix_program():
         assert any(n.startswith("llama-paged-prefix-") for n in names), names
     finally:
         eng.stop()
+
+
+def test_prefix_composes_with_tp_mesh():
+    """The config-5 default stack: paged pool sharded over a tp mesh WITH
+    the prefix cache on. The tail-only program's gather/scatter must ride
+    the sharded KV-head axis; hits must still serve token-for-token equal
+    to the unsharded engine."""
+    import jax
+
+    from gofr_tpu.parallel import MeshPlan, make_mesh
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 devices")
+    mesh = make_mesh(MeshPlan(tp=2), devices=jax.devices()[:2])
+
+    def serve(m):
+        params = llama_init(CFG, seed=0)
+        eng = PagedLLMEngine(params, CFG, n_slots=4, max_seq_len=128,
+                             prefill_buckets=(8, 32, 64), page_size=PS,
+                             prefix_cache=True, mesh=m,
+                             logger=MockLogger())
+        eng.start()
+        try:
+            outs = [_gen(eng, SYSTEM + [40, 41, 42]),
+                    _gen(eng, SYSTEM + [50, 51])]
+            assert eng.prefix.hit_pages == 4
+            return outs
+        finally:
+            eng.stop()
+
+    assert serve(mesh) == serve(None)
