@@ -1844,6 +1844,15 @@ class LLMEngine:
         slot.length = 0
         slot.remaining = 0
         slot.history = None
+        if (self.sampling_controls and request is not None
+                and (request.top_p or request.top_k)):
+            # zero the freed slot's device-side control row: the sampler
+            # gates its [B, V] sort on ANY row's top_p/top_k, so a stale
+            # row would keep every later all-greedy batch paying the sort
+            idx = next((i for i, s in enumerate(self.slots) if s is slot),
+                       None)
+            if idx is not None:
+                self._temps = self._temps.at[idx].set(0.0)
         if request is not None:
             request.finished_at = time.time()
             if request.gen_span is not None:

@@ -1,8 +1,11 @@
 """On-device token sampling: greedy / temperature / top-k / top-p.
 
-All branches are compiled into one program (lax.cond-free masking) so the
-decode step stays a single XLA executable regardless of per-request settings:
-temperature==0 rows take the argmax path via jnp.where.
+All per-row behavior is masking inside ONE compiled program (temperature==0
+rows take the argmax path via jnp.where) with a single exception: the
+per-row top_p/top_k filter pair sits behind a data-dependent jax.lax.cond
+so a batch with no active filter skips the [B, V] sort at RUNTIME. Under
+plain jit (every engine call site) cond executes one branch; a vmap over
+this function would lower it to a both-branches select — don't.
 
 Two control planes, chosen by the SHAPE of `samp`:
   - [B]    float32: per-row temperature only (the lean serving default —
@@ -64,21 +67,29 @@ def sample_tokens(logits, rng, samp, top_k: int = 0, top_p: float = 0.0):
     if samp.ndim == 2:
         top_p_row = samp[:, 1]
         top_k_row = samp[:, 2]
-        # ONE descending sort serves both per-row filters; each filter is
-        # computed against the same (temperature-scaled) distribution, and
-        # a row's 0 disables that filter via the mask term
-        sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]
-        k_idx = jnp.clip(top_k_row.astype(jnp.int32) - 1, 0, V - 1)[:, None]
-        kth = jnp.take_along_axis(sorted_desc, k_idx, axis=-1)   # [B, 1]
-        scaled = jnp.where((top_k_row[:, None] > 0) & (scaled < kth),
-                           -1e30, scaled)
-        probs = jax.nn.softmax(sorted_desc, axis=-1)
-        cumulative = jnp.cumsum(probs, axis=-1)
-        cutoff_idx = jnp.sum(cumulative < top_p_row[:, None], axis=-1,
-                             keepdims=True)
-        cutoff = jnp.take_along_axis(sorted_desc, cutoff_idx, axis=-1)
-        scaled = jnp.where((top_p_row[:, None] > 0) & (scaled < cutoff),
-                           -1e30, scaled)
+
+        def _row_filters(s):
+            # ONE descending sort serves both per-row filters; each filter
+            # is computed against the same (temperature-scaled)
+            # distribution, and a row's 0 disables it via the mask term
+            sorted_desc = jnp.sort(s, axis=-1)[:, ::-1]
+            k_idx = jnp.clip(top_k_row.astype(jnp.int32) - 1, 0,
+                             V - 1)[:, None]
+            kth = jnp.take_along_axis(sorted_desc, k_idx, axis=-1)  # [B,1]
+            s = jnp.where((top_k_row[:, None] > 0) & (s < kth), -1e30, s)
+            probs = jax.nn.softmax(sorted_desc, axis=-1)
+            cumulative = jnp.cumsum(probs, axis=-1)
+            cutoff_idx = jnp.sum(cumulative < top_p_row[:, None], axis=-1,
+                                 keepdims=True)
+            cutoff = jnp.take_along_axis(sorted_desc, cutoff_idx, axis=-1)
+            return jnp.where((top_p_row[:, None] > 0) & (s < cutoff),
+                             -1e30, s)
+
+        # a sampling_controls engine mostly serving greedy/plain traffic
+        # must not pay the [B, V] sort every step: cond executes ONE
+        # branch at runtime, so batches with no active row filter skip it
+        any_filter = jnp.any((top_p_row > 0.0) | (top_k_row > 0.0))
+        scaled = jax.lax.cond(any_filter, _row_filters, lambda s: s, scaled)
 
     sampled = jax.random.categorical(sub, scaled, axis=-1).astype(jnp.int32)
     tokens = jnp.where(temperature <= 0.0, greedy, sampled)

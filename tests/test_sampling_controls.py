@@ -172,3 +172,25 @@ def test_paged_speculative_composes_with_controls():
         eng.stop()
     assert got == _serve(controls=False, submits=[
         {"max_new_tokens": 12, "temperature": 0.0} for _ in PROMPTS])
+
+
+def test_control_row_clears_when_slot_frees():
+    """A finished top_p/top_k request must not leave its device-side
+    control row behind — the sampler gates its [B, V] sort on ANY row's
+    controls, so a stale row would tax every later all-greedy batch."""
+    params = llama_init(CFG, seed=0)
+    eng = LLMEngine(params, CFG, n_slots=2, max_seq_len=64,
+                    prefill_buckets=(8,), sampling_controls=True)
+    eng.start()
+    try:
+        eng.submit([1, 2, 3], max_new_tokens=4, temperature=0.9,
+                   top_p=0.5, top_k=3).result(timeout_s=300)
+        deadline = 300
+        import time as _t
+        end = _t.time() + deadline
+        while any(s.active for s in eng.slots) and _t.time() < end:
+            _t.sleep(0.01)
+        controls = np.asarray(eng._temps)[:, 1:]
+        assert (controls == 0.0).all(), controls
+    finally:
+        eng.stop()
