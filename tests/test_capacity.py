@@ -158,3 +158,53 @@ def test_int8_kv_plan_fits_more():
     q8_bytes = (kv_cache_bytes(cfg8, 128, 2048, dtype="int8")
                 + 2 * cfg.n_layers * 128 * cfg.n_kv_heads * 2048 * 4)
     assert q8_bytes < 0.6 * bf16_bytes
+
+
+def test_llama3_8b_int8_weights_fit_one_v5e_chip():
+    """BASELINE config 4 feasibility: 8B bf16 weights (~15 GiB) cannot fit
+    a 16 GiB chip with any KV at all, but the int8 tree (~8 GiB) plans a
+    real serving config — the arithmetic bench.py's T3 stage relies on."""
+    import dataclasses
+
+    from gofr_tpu.models.llama import LlamaConfig
+
+    cfg = dataclasses.replace(LlamaConfig.llama3_8b(),
+                              decode_attn="kernel", kv_dtype="int8")
+    w8_bytes = cfg.param_count() * 1 + 4 * (
+        # per-output-channel f32 scales: one per output column per matmul
+        cfg.vocab_size * 2 + cfg.n_layers * (
+            cfg.n_heads * cfg.head_dim + 2 * cfg.n_kv_heads * cfg.head_dim
+            + cfg.dim + 2 * cfg.ffn_dim + cfg.dim))
+    budget = 16 << 30
+    plan = plan_capacity(cfg, n_slots=64, max_seq_len=512,
+                         budget_bytes=budget, paged=True,
+                         prefill_buckets=(16, 64, 128, 256),
+                         params_nbytes=w8_bytes)
+    assert plan.fits
+    assert plan.n_slots >= 32, plan.summary()       # real batch, not a toy
+    assert plan.max_seq_len >= 256, plan.summary()
+    # and the bf16 tree genuinely cannot serve at all on this budget
+    with pytest.raises(ValueError, match="cannot serve"):
+        plan_capacity(dataclasses.replace(cfg, kv_dtype=None),
+                      n_slots=1, max_seq_len=128, budget_bytes=budget,
+                      min_slots=1, min_seq=128)
+
+
+def test_llama3_70b_int8_weights_fit_tp8_slice():
+    """BASELINE config 5 feasibility: 70B int8 weights (~65 GiB) + an int8
+    pool plan inside a v5e-8 slice's aggregate HBM (8 x 16 GiB), which is
+    how the engine budgets under a mesh (per-device bytes x mesh size)."""
+    import dataclasses
+
+    from gofr_tpu.models.llama import LlamaConfig
+
+    cfg = dataclasses.replace(LlamaConfig.llama3_70b(),
+                              decode_attn="kernel", kv_dtype="int8")
+    w8_bytes = cfg.param_count()                  # int8: ~1 byte per param
+    budget = 8 * (16 << 30)
+    plan = plan_capacity(cfg, n_slots=64, max_seq_len=2048,
+                         budget_bytes=budget, paged=True,
+                         prefill_buckets=(64, 256, 512),
+                         params_nbytes=w8_bytes)
+    assert plan.fits
+    assert plan.n_slots * plan.max_seq_len >= 64 * 512, plan.summary()
