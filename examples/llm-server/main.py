@@ -4,9 +4,11 @@ POST /generate {"prompt": "...", "max_tokens": 64, "temperature": 0.7,
 "stream": true} -> server-sent events, one JSON per token chunk, then a final
 {"done": true} summary. stream=false returns one JSON response.
 
-Model size comes from MODEL_PRESET (debug | llama1b | llama3-8b); weights are
-random-initialised (no checkpoints ship in this environment) — the serving
-path, throughput, and latency behavior are identical to real weights.
+Model size comes from MODEL_PRESET (debug | llama1b | llama3-8b). Weights
+boot from a real HF-layout safetensors checkpoint when WEIGHTS_PATH is set
+(models.weights.load_llama_safetensors — streaming, int8 quantize-on-load);
+otherwise random-initialised (no checkpoints ship in this environment) with
+identical serving/throughput/latency behavior.
 """
 
 import os
@@ -29,6 +31,23 @@ PRESETS = {
     "llama3-8b": LlamaConfig.llama3_8b,
     "llama3-70b": LlamaConfig.llama3_70b,  # TP_SHARDS=8 territory (config 5)
 }
+
+
+def _load_tokenizer(path: str):
+    """VOCAB_PATH format sniffing: HF tokenizer.json (byte-level BPE, what
+    real Llama-3 checkpoints ship), tiktoken .model (Meta's distribution),
+    or the framework's own {vocab, merges} JSON."""
+    from gofr_tpu.models.tokenizer import BPETokenizer, ByteLevelBPETokenizer
+
+    if path.endswith((".model", ".tiktoken")):
+        return ByteLevelBPETokenizer.from_tiktoken(path)
+    import json as _json
+
+    with open(path, "r", encoding="utf-8") as fp:
+        head = _json.load(fp)
+    if "model" in head and "vocab" in head.get("model", {}):
+        return ByteLevelBPETokenizer.from_tokenizer_json(path, data=head)
+    return BPETokenizer.from_file(path)
 
 
 def build_engine(app: App, default_sampling_controls: bool = False) -> LLMEngine:
@@ -60,12 +79,10 @@ def build_engine(app: App, default_sampling_controls: bool = False) -> LLMEngine
     # without it the exact-and-reversible byte tokenizer serves
     vocab_path = app.config.get_or_default("VOCAB_PATH", "")
     if vocab_path:
-        from gofr_tpu.models.tokenizer import BPETokenizer
-
-        tokenizer = BPETokenizer.from_file(vocab_path)
-        app.logger.infof("loaded BPE vocab from %s (%d tokens, native=%s)",
-                         vocab_path, tokenizer.vocab_size,
-                         tokenizer._native is not None)
+        tokenizer = _load_tokenizer(vocab_path)
+        app.logger.infof("loaded vocab from %s (%s, %d tokens)",
+                         vocab_path, type(tokenizer).__name__,
+                         tokenizer.vocab_size)
     else:
         tokenizer = ByteTokenizer()
     if cfg.vocab_size < tokenizer.vocab_size:
@@ -82,7 +99,22 @@ def build_engine(app: App, default_sampling_controls: bool = False) -> LLMEngine
     if weight_dtype not in (None, "int8"):
         raise ValueError(f"WEIGHT_DTYPE must be int8 or unset, "
                          f"got {weight_dtype!r}")
-    if weight_dtype == "int8":
+    # WEIGHTS_PATH boots from a real HF-layout safetensors checkpoint
+    # (file, directory, or sharded index) — shapes validated against the
+    # preset before any bytes load; WEIGHT_DTYPE=int8 quantizes each leaf
+    # on device as it streams in, so the float tree never materializes
+    weights_path = app.config.get_or_default("WEIGHTS_PATH", "")
+    if weights_path:
+        from gofr_tpu.models.weights import load_llama_safetensors
+
+        t_load = time.time()
+        params = load_llama_safetensors(cfg, weights_path,
+                                        weight_dtype=weight_dtype,
+                                        logger=app.logger)
+        app.logger.infof("loaded weights from %s in %.1fs (%s)",
+                         weights_path, time.time() - t_load,
+                         weight_dtype or cfg.dtype)
+    elif weight_dtype == "int8":
         from gofr_tpu.models.llama import llama_init_quantized
 
         params = llama_init_quantized(cfg, seed=0)

@@ -52,16 +52,24 @@ class StreamingDecoder:
     def __init__(self, tokenizer=None):
         self.tokenizer = tokenizer or ByteTokenizer()
         self._buf = bytearray()
-        self._piecewise = not isinstance(self.tokenizer, ByteTokenizer)
+        # byte-level BPE pieces are raw bytes that can split a codepoint
+        # mid-token (decode_token_bytes) — they buffer like byte tokens;
+        # char-level BPE pieces are whole strings (no buffering needed)
+        self._byte_pieces = hasattr(self.tokenizer, "decode_token_bytes")
+        self._piecewise = (not self._byte_pieces
+                           and not isinstance(self.tokenizer, ByteTokenizer))
 
     def push(self, token: int) -> str:
         from .. import native
 
         if self._piecewise:
             return self.tokenizer.decode_token(token)
-        if not (0 <= token < 256):
+        if self._byte_pieces:
+            self._buf.extend(self.tokenizer.decode_token_bytes(token))
+        elif not (0 <= token < 256):
             return ""
-        self._buf.append(token)
+        else:
+            self._buf.append(token)
         # boundary scan in C (pure-python mirror when the lib is absent):
         # emit every complete codepoint, keep the valid-but-incomplete tail
         n = native.utf8_complete_prefix(bytes(self._buf))
@@ -171,3 +179,257 @@ class BPETokenizer:
     def decode(self, ids: Sequence[int]) -> str:
         return "".join(self.inv_vocab.get(i, "") for i in ids
                        if i not in (self.bos_id, self.eos_id))
+
+
+# ---------------------------------------------------------------------------
+# Byte-level BPE (the real Llama-3 / GPT-2 vocab family)
+# ---------------------------------------------------------------------------
+
+def bytes_to_unicode() -> Dict[int, str]:
+    """The standard GPT-2 byte<->unicode table: every one of the 256 byte
+    values maps to a printable unicode char so BPE vocab pieces are plain
+    strings. Printable ASCII/latin ranges map to themselves; the rest shift
+    up past 255 in discovery order. This is the published convention every
+    byte-level vocab (GPT-2, Llama-3, Qwen) is keyed in — reimplementing it
+    is the price of reading those vocab files with zero deps."""
+    bs = (list(range(ord("!"), ord("~") + 1))
+          + list(range(0xA1, 0xAD)) + list(range(0xAE, 0x100)))
+    cs = bs[:]
+    n = 0
+    for b in range(256):
+        if b not in bs:
+            bs.append(b)
+            cs.append(256 + n)
+            n += 1
+    return dict(zip(bs, map(chr, cs)))
+
+
+_B2U = None
+_U2B = None
+
+
+def _byte_maps():
+    global _B2U, _U2B
+    if _B2U is None:
+        _B2U = bytes_to_unicode()
+        _U2B = {c: b for b, c in _B2U.items()}
+    return _B2U, _U2B
+
+
+# Llama-3's pre-tokenizer split pattern (the tiktoken cl100k family).
+# Needs the `regex` module for \p classes; a conservative fallback splits
+# on whitespace boundaries only (less compression, identical reversibility).
+_LLAMA3_SPLIT = (r"(?i:'s|'t|'re|'ve|'m|'ll|'d)|[^\r\n\p{L}\p{N}]?\p{L}+"
+                 r"|\p{N}{1,3}| ?[^\s\p{L}\p{N}]+[\r\n]*|\s*[\r\n]+"
+                 r"|\s+(?!\S)|\s+")
+
+
+class ByteLevelBPETokenizer:
+    """Byte-level BPE over a real model vocabulary (Llama-3/GPT-2 family).
+
+    vocab keys are strings in the byte-unicode space (bytes_to_unicode);
+    merges rank adjacent-pair fusions. When merges are absent (tiktoken-
+    format vocabs) the token id IS the rank — the two schemes produce the
+    same greedy segmentation because tiktoken vocabs are rank-ordered by
+    construction.
+
+    Encoding: text -> pre-tokenizer split (regex) -> per-piece UTF-8 bytes
+    -> byte-unicode chars -> greedy lowest-rank merges -> ids. Special
+    tokens (<|begin_of_text|> etc.) are matched exactly BEFORE the split so
+    prompt templates tokenize correctly.
+
+    Parity target: the reference keeps request-path text processing inside
+    the serving process rather than a sidecar (SURVEY §7.5); this class is
+    what VOCAB_PATH deploys for real checkpoints, next to
+    weights.load_llama_safetensors.
+    """
+
+    def __init__(self, vocab: Dict[str, int], merges: Optional[List[str]] = None,
+                 special_tokens: Optional[Dict[str, int]] = None,
+                 bos_token: str = "<|begin_of_text|>",
+                 eos_token: str = "<|end_of_text|>"):
+        self.vocab = vocab
+        self.special_tokens = dict(special_tokens or {})
+        self.inv_vocab = {v: k for k, v in vocab.items()}
+        self.inv_special = {v: k for k, v in self.special_tokens.items()}
+        # Merge lookup is keyed by the (left, right) PAIR, not the fused
+        # string — two different pairs can concatenate to the same piece
+        # and only the listed pair is a rule (HF BPE semantics). In
+        # tiktoken rank-mode there are no explicit rules: any adjacent pair
+        # whose fusion exists in the vocab merges, ranked by the fused
+        # piece's id (tiktoken's own algorithm), so the key IS the fusion.
+        if merges:
+            self._pair_ranks: Optional[Dict[tuple, int]] = {}
+            for i, m in enumerate(merges):
+                left, _, right = m.partition(" ")
+                self._pair_ranks.setdefault((left, right), i)
+        else:
+            self._pair_ranks = None
+        self._fused_ranks = dict(vocab)
+        self.bos_id = self.special_tokens.get(bos_token, vocab.get(bos_token))
+        self.eos_id = self.special_tokens.get(eos_token, vocab.get(eos_token))
+        all_ids = list(vocab.values()) + list(self.special_tokens.values())
+        self.vocab_size = max(all_ids) + 1 if all_ids else 0
+        self._split = self._compile_split()
+        # longest-first exact matcher for special tokens inside encode()
+        import re as _re
+
+        self._special_re = (_re.compile("|".join(
+            _re.escape(t) for t in sorted(self.special_tokens,
+                                          key=len, reverse=True)))
+            if self.special_tokens else None)
+
+    @staticmethod
+    def _compile_split():
+        try:
+            import regex
+
+            return regex.compile(_LLAMA3_SPLIT)
+        except ImportError:  # pragma: no cover - regex ships with jax deps
+            import re
+
+            return re.compile(r"\s+|\S+")
+
+    # ByteTokenizer-compatible surface
+    @property
+    def BOS(self) -> int:
+        return self.bos_id if self.bos_id is not None else -1
+
+    @property
+    def EOS(self) -> int:
+        return self.eos_id if self.eos_id is not None else -1
+
+    def _bpe(self, chars: List[str]) -> List[str]:
+        """Greedy lowest-rank adjacent merge until no fusable pair remains."""
+        pair_ranks = self._pair_ranks
+        fused_ranks = self._fused_ranks
+        word = chars
+        while len(word) > 1:
+            best_rank = None
+            best_i = -1
+            for i in range(len(word) - 1):
+                if pair_ranks is not None:
+                    r = pair_ranks.get((word[i], word[i + 1]))
+                else:
+                    r = fused_ranks.get(word[i] + word[i + 1])
+                if r is not None and (best_rank is None or r < best_rank):
+                    best_rank, best_i = r, i
+            if best_i < 0:
+                break
+            word = (word[:best_i] + [word[best_i] + word[best_i + 1]]
+                    + word[best_i + 2:])
+        return word
+
+    def _encode_text(self, text: str) -> List[int]:
+        b2u, _ = _byte_maps()
+        ids: List[int] = []
+        for piece in self._split.findall(text):
+            mapped = [b2u[b] for b in piece.encode("utf-8")]
+            for tok in self._bpe(mapped):
+                tid = self.vocab.get(tok)
+                if tid is None:
+                    # byte-level vocabs contain every single byte; this
+                    # only triggers on truncated vocab fixtures
+                    ids.extend(self.vocab.get(c, 0) for c in tok)
+                else:
+                    ids.append(tid)
+        return ids
+
+    def encode(self, text: str, bos: bool = True, eos: bool = False,
+               parse_special: bool = False) -> List[int]:
+        """parse_special=False (the default) treats special-token strings in
+        `text` as plain text — the safe mode for untrusted request prompts
+        (a client typing '<|eot_id|>' must not forge a turn boundary;
+        tiktoken's allowed_special discipline). Chat-template builders that
+        intentionally embed specials pass parse_special=True."""
+        ids: List[int] = []
+        if bos and self.bos_id is not None:
+            ids.append(self.bos_id)
+        if self._special_re is None or not parse_special:
+            ids.extend(self._encode_text(text))
+        else:
+            pos = 0
+            for m in self._special_re.finditer(text):
+                if m.start() > pos:
+                    ids.extend(self._encode_text(text[pos:m.start()]))
+                ids.append(self.special_tokens[m.group()])
+                pos = m.end()
+            if pos < len(text):
+                ids.extend(self._encode_text(text[pos:]))
+        if eos and self.eos_id is not None:
+            ids.append(self.eos_id)
+        return ids
+
+    def decode_token_bytes(self, token: int) -> bytes:
+        """Raw bytes of one token (StreamingDecoder buffers these so SSE
+        never emits a torn codepoint — byte-level pieces can split UTF-8)."""
+        if token in self.inv_special or token in (self.bos_id, self.eos_id):
+            return b""
+        piece = self.inv_vocab.get(token)
+        if piece is None:
+            return b""
+        _, u2b = _byte_maps()
+        return bytes(u2b[c] for c in piece)
+
+    def decode_token(self, token: int) -> str:
+        return self.decode_token_bytes(token).decode("utf-8", errors="ignore")
+
+    def decode(self, ids: Sequence[int]) -> str:
+        data = b"".join(self.decode_token_bytes(i) for i in ids)
+        return data.decode("utf-8", errors="replace")
+
+    # ---- loaders ---------------------------------------------------------
+
+    @classmethod
+    def from_tokenizer_json(cls, path: str, data: Optional[dict] = None,
+                            **kw) -> "ByteLevelBPETokenizer":
+        """Load an HF `tokenizer.json` (the file real Llama-3 checkpoints
+        ship): model.vocab + model.merges + added_tokens. Merges appear as
+        "a b" strings (classic) or [a, b] pairs (tokenizers>=0.20).
+        `data` skips the re-parse when the caller already json.load()ed the
+        file (a real tokenizer.json is ~9 MB)."""
+        if data is None:
+            with open(path, "r", encoding="utf-8") as fp:
+                data = json.load(fp)
+        model = data.get("model", {})
+        vocab = model.get("vocab", {})
+        merges_raw = model.get("merges", [])
+        merges = [m if isinstance(m, str) else " ".join(m)
+                  for m in merges_raw]
+        specials = {t["content"]: t["id"]
+                    for t in data.get("added_tokens", [])
+                    if t.get("special", True)}
+        return cls(vocab, merges, special_tokens=specials, **kw)
+
+    @classmethod
+    def from_tiktoken(cls, path: str,
+                      special_tokens: Optional[Dict[str, int]] = None,
+                      **kw) -> "ByteLevelBPETokenizer":
+        """Load a tiktoken-format vocab (Meta's llama-3 distribution:
+        one `base64(token_bytes) rank` pair per line). Pieces arrive as raw
+        bytes; they re-key into the byte-unicode space so one encode path
+        serves both formats. Merge ranks are the ids themselves."""
+        import base64
+
+        b2u, _ = _byte_maps()
+        vocab: Dict[str, int] = {}
+        with open(path, "r", encoding="utf-8") as fp:
+            for line in fp:
+                line = line.strip()
+                if not line:
+                    continue
+                b64, _, rank = line.partition(" ")
+                piece = "".join(b2u[b] for b in base64.b64decode(b64))
+                vocab[piece] = int(rank)
+        if special_tokens is None:
+            # Meta's llama-3 special-token layout: specials start right
+            # after the base vocab (n=128000 for the real model)
+            n = len(vocab)
+            special_tokens = {
+                "<|begin_of_text|>": n, "<|end_of_text|>": n + 1,
+                "<|finetune_right_pad_id|>": n + 4,
+                "<|start_header_id|>": n + 6, "<|end_header_id|>": n + 7,
+                "<|eom_id|>": n + 8, "<|eot_id|>": n + 9,
+                "<|python_tag|>": n + 10,
+            }
+        return cls(vocab, None, special_tokens=special_tokens, **kw)

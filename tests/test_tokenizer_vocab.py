@@ -76,3 +76,148 @@ def test_from_file_roundtrip(tmp_path):
     t = BPETokenizer.from_file(str(path))
     assert t.encode("ab", bos=False) == [2]
     assert t.vocab_size == 5
+
+
+# ---------------------------------------------------------------------------
+# Byte-level BPE (real Llama-3/GPT-2 vocab family)
+# ---------------------------------------------------------------------------
+
+from gofr_tpu.models.tokenizer import ByteLevelBPETokenizer, bytes_to_unicode
+
+
+def _mini_byte_level():
+    """A tiny but REAL-format byte-level vocab: single-byte pieces for the
+    chars used + merges building 'hello' and ' world', exactly how a
+    trained GPT-2-family vocab is keyed (space is the byte-unicode 'Ġ')."""
+    b2u = bytes_to_unicode()
+    used = bytes(range(32, 127)) + "\xe9".encode("utf-8")
+    chars = sorted({b2u[b] for b in used})
+    vocab = {c: i for i, c in enumerate(chars)}
+    merges = ["h e", "l l", "he ll", "hell o",
+              f"{b2u[ord(' ')]} w", "o r", "or l",
+              f"{b2u[ord(' ')]}w orl", f"{b2u[ord(' ')]}worl d"]
+    for m in merges:
+        left, _, right = m.partition(" ")
+        vocab.setdefault(left + right, len(vocab))
+    specials = {"<|begin_of_text|>": len(vocab),
+                "<|end_of_text|>": len(vocab) + 1}
+    return vocab, merges, specials
+
+
+def test_byte_level_golden_merges():
+    vocab, merges, specials = _mini_byte_level()
+    tok = ByteLevelBPETokenizer(vocab, merges, special_tokens=specials)
+    ids = tok.encode("hello world", bos=False)
+    assert [tok.inv_vocab[i] for i in ids] == ["hello", "Ġworld"]
+    assert tok.decode(ids) == "hello world"
+
+
+def test_byte_level_bos_and_specials_inline():
+    vocab, merges, specials = _mini_byte_level()
+    tok = ByteLevelBPETokenizer(vocab, merges, special_tokens=specials)
+    ids = tok.encode("hello<|end_of_text|>", bos=True, parse_special=True)
+    assert ids[0] == tok.BOS
+    assert ids[-1] == specials["<|end_of_text|>"]
+    assert tok.decode(ids) == "hello"  # specials render empty
+
+
+def test_special_strings_in_untrusted_text_do_not_inject():
+    """Default encode treats '<|eot_id|>'-style strings as PLAIN TEXT — a
+    client prompt must not forge control tokens (tiktoken's
+    allowed_special discipline)."""
+    vocab, merges, specials = _mini_byte_level()
+    tok = ByteLevelBPETokenizer(vocab, merges, special_tokens=specials)
+    ids = tok.encode("hello<|end_of_text|>", bos=False)
+    assert specials["<|end_of_text|>"] not in ids
+    assert tok.decode(ids) == "hello<|end_of_text|>"
+
+
+def test_merges_are_pair_keyed_not_fusion_keyed():
+    """HF BPE semantics: a pair is only mergeable if IT is a rule — a pair
+    whose concatenation merely collides with another rule's output must
+    not fuse. vocab {a,b,c,bc,ab,abc}, merges [b c, a b, ab c]: 'abc' must
+    segment as a+bc (pair (a,bc) is NOT a rule even though 'abc' is a
+    piece), matching reference HF tokenizers."""
+    vocab = {c: i for i, c in enumerate(["a", "b", "c", "bc", "ab", "abc"])}
+    tok = ByteLevelBPETokenizer(vocab, ["b c", "a b", "ab c"],
+                                special_tokens={})
+    pieces = [tok.inv_vocab[i] for i in tok.encode("abc", bos=False)]
+    assert pieces == ["a", "bc"]
+
+
+def test_tiktoken_mode_fuses_by_vocab_rank():
+    """tiktoken rank-mode HAS no explicit rules: any pair whose fusion is
+    in the vocab merges, lowest fused-id first."""
+    vocab = {c: i for i, c in enumerate(["a", "b", "c", "bc", "ab", "abc"])}
+    tok = ByteLevelBPETokenizer(vocab, None, special_tokens={})
+    pieces = [tok.inv_vocab[i] for i in tok.encode("abc", bos=False)]
+    # 'bc' (id 3) outranks 'ab' (id 4); then (a, bc) -> 'abc' exists
+    assert pieces == ["abc"]
+
+
+def test_byte_level_multibyte_utf8_streaming():
+    """A codepoint split across byte-level pieces must never reach the SSE
+    stream torn: StreamingDecoder buffers decode_token_bytes output."""
+    from gofr_tpu.models.tokenizer import StreamingDecoder
+
+    vocab, merges, specials = _mini_byte_level()
+    tok = ByteLevelBPETokenizer(vocab, merges, special_tokens=specials)
+    ids = tok.encode("caf\xe9"[3:], bos=False)  # just 'é': two bytes
+    assert len(ids) == 2  # no merge for the pair -> two single-byte pieces
+    dec = StreamingDecoder(tok)
+    assert dec.push(ids[0]) == ""          # half a codepoint: held back
+    assert dec.push(ids[1]) == "\xe9"      # completed
+    assert dec.flush() == ""
+
+
+def test_from_tokenizer_json_both_merge_shapes(tmp_path):
+    vocab, merges, specials = _mini_byte_level()
+    for shape in ("str", "pair"):
+        data = {
+            "model": {"type": "BPE", "vocab": vocab,
+                      "merges": (merges if shape == "str"
+                                 else [m.split(" ") for m in merges])},
+            "added_tokens": [
+                {"id": i, "content": c, "special": True}
+                for c, i in specials.items()],
+        }
+        path = str(tmp_path / f"tokenizer_{shape}.json")
+        with open(path, "w") as fp:
+            json.dump(data, fp)
+        tok = ByteLevelBPETokenizer.from_tokenizer_json(path)
+        assert tok.BOS == specials["<|begin_of_text|>"]
+        ids = tok.encode("hello world", bos=False)
+        assert tok.decode(ids) == "hello world"
+        assert [tok.inv_vocab[i] for i in ids] == ["hello", "Ġworld"]
+
+
+def test_from_tiktoken_rank_merges(tmp_path):
+    """tiktoken format: base64 bytes + rank per line, merge order = id
+    order. The same segmentation falls out when the vocab lists merged
+    pieces after their halves (how trained vocabs are ordered)."""
+    import base64
+
+    b2u = bytes_to_unicode()
+    u2b = {c: b for b, c in b2u.items()}
+    vocab, merges, _ = _mini_byte_level()
+    # re-rank so vocab order is merge order (already true by construction)
+    lines = []
+    for piece, rank in sorted(vocab.items(), key=lambda kv: kv[1]):
+        raw = bytes(u2b[c] for c in piece)
+        lines.append(f"{base64.b64encode(raw).decode()} {rank}")
+    path = str(tmp_path / "tokenizer.model")
+    with open(path, "w") as fp:
+        fp.write("\n".join(lines) + "\n")
+    tok = ByteLevelBPETokenizer.from_tiktoken(path)
+    ids = tok.encode("hello world", bos=False)
+    assert [tok.inv_vocab[i] for i in ids] == ["hello", "Ġworld"]
+    assert tok.BOS == len(vocab)  # Meta convention: first id past vocab
+
+
+def test_byte_unicode_table_is_bijective():
+    b2u = bytes_to_unicode()
+    assert len(b2u) == 256
+    assert len(set(b2u.values())) == 256
+    # printable ascii maps to itself (the property vocab files rely on)
+    assert b2u[ord("A")] == "A"
+    assert b2u[ord(" ")] == "Ġ"  # Ġ — the leading-space marker
