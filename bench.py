@@ -190,6 +190,14 @@ class _Record:
         self.result = {"metric": metric, "value": 0.0, "unit": "tok/s",
                        "vs_baseline": 0.0, "platform": platform,
                        "fallback_reason": fallback_reason, "extras": {}}
+        if platform != "tpu":
+            # a CPU fallback is a smoke test of the harness, not a perf
+            # claim: say so explicitly instead of letting a tiny
+            # vs_baseline imply a measured shortfall (VERDICT r4 weak #8)
+            self.result["smoke_only"] = True
+            self.result["note"] = ("non-TPU fallback: value is a harness "
+                                   "smoke check, not a performance "
+                                   "measurement")
         # the watchdog thread also emits; serialize mutation+dump and write
         # the line atomically so a concurrent emit can never garble the
         # final parseable record

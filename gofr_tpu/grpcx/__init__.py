@@ -130,6 +130,17 @@ class GRPCServer:
         self._server.add_generic_rpc_handlers(
             (grpc.method_handlers_generic_handler(name, handlers),))
 
+    def _status_for(self, exc: BaseException):
+        """Client-input errors abort INVALID_ARGUMENT; everything else is a
+        server fault (INTERNAL). Mirrors the HTTP surface, where the same
+        engine.submit validation raises map to 400 (ADVICE r4): a gRPC
+        client must be able to tell a bad request from a broken server."""
+        from ..http.errors import InvalidParam
+
+        if isinstance(exc, (ValueError, InvalidParam)):
+            return self._grpc.StatusCode.INVALID_ARGUMENT
+        return self._grpc.StatusCode.INTERNAL
+
     def _adapt(self, full_method: str, fn, serializer):
         def handle(payload, grpc_ctx):
             start = time.time()
@@ -148,7 +159,7 @@ class GRPCServer:
             except Exception as exc:  # noqa: BLE001 - recovery interceptor (grpc.go:23-25)
                 status = "ERROR"
                 self.logger.errorf("grpc handler %s failed: %s", full_method, exc)
-                grpc_ctx.abort(self._grpc.StatusCode.INTERNAL, str(exc))
+                grpc_ctx.abort(self._status_for(exc), str(exc))
             finally:
                 duration_us = int((time.time() - start) * 1e6)
                 trace_id = span.trace_id if span else ""
@@ -163,8 +174,9 @@ class GRPCServer:
         """Server-streaming twin of _adapt: the handler's return value is
         iterated and each item serialized as one stream message. The RPC
         log records total duration and message count at stream end; a
-        handler exception mid-stream aborts with INTERNAL (the recovery
-        interceptor posture — never a silent truncation)."""
+        handler exception mid-stream aborts the RPC (INVALID_ARGUMENT for
+        client-input errors, INTERNAL otherwise — the recovery interceptor
+        posture, never a silent truncation)."""
         def handle(payload, grpc_ctx):
             start = time.time()
             metadata = {k: v for k, v in (grpc_ctx.invocation_metadata() or [])}
@@ -185,7 +197,7 @@ class GRPCServer:
                 status = "ERROR"
                 self.logger.errorf("grpc stream %s failed after %d messages: %s",
                                    full_method, sent, exc)
-                grpc_ctx.abort(self._grpc.StatusCode.INTERNAL, str(exc))
+                grpc_ctx.abort(self._status_for(exc), str(exc))
             finally:
                 duration_us = int((time.time() - start) * 1e6)
                 trace_id = span.trace_id if span else ""

@@ -313,15 +313,20 @@ class Executor:
             # arg shapes; scrub addresses so the signature is stable
             shard_sig = _re.sub(r"0x[0-9a-f]+", "",
                                 repr((in_shardings, out_shardings)))
+        # the device signature is part of the IN-MEMORY key too: two engines
+        # sharing one Executor with identical names/shapes but different
+        # meshes (or devices) must not be handed each other's programs
+        # (ADVICE r4) — the same topology identity that keys disk artifacts
+        dev_sig = self._args_device_sig(args)
         key = (name, _abstract_key([a for i, a in enumerate(args) if i not in static_argnums]),
-               tuple(static_argnums), tuple(donate_argnums), shard_sig)
+               tuple(static_argnums), tuple(donate_argnums), shard_sig,
+               dev_sig)
         with self._lock:
             cached = self._cache.get(key)
         if cached is not None:
             self._observe_compile(name, 0.0, hit=True)
             return cached
 
-        dev_sig = self._args_device_sig(args)
         loaded = self._load_from_disk(name, key, fn, dev_sig)
         if loaded is not None:
             with self._lock:

@@ -135,14 +135,14 @@ class PrefixCache:
             prev_key = key
             self.inserted_pages += 1
 
-    def ref_owned(self, page_id: int) -> None:
-        """Take a slot ref on a page the cache owns (fresh-page insert path
-        counts the owner through insert; this is for explicit re-refs)."""
-        self._refs[page_id] += 1
-
     def unref(self, page_id: int) -> None:
-        self._refs[page_id] -= 1
-        assert self._refs[page_id] >= 0, f"page {page_id} over-released"
+        # a loud error, not assert: under python -O a silent negative ref
+        # would make the page permanently fail the refs==0 eviction check —
+        # an unevictable leak (ADVICE r4)
+        refs = self._refs[page_id] - 1
+        if refs < 0:
+            raise RuntimeError(f"prefix page {page_id} over-released")
+        self._refs[page_id] = refs
 
     def evict(self, n: int) -> List[int]:
         """Reclaim up to n LRU pages with no active refs AND no resident

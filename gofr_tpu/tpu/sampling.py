@@ -69,19 +69,26 @@ def sample_tokens(logits, rng, samp, top_k: int = 0, top_p: float = 0.0):
         top_k_row = samp[:, 2]
 
         def _row_filters(s):
-            # ONE descending sort serves both per-row filters; each filter
-            # is computed against the same (temperature-scaled)
-            # distribution, and a row's 0 disables it via the mask term
+            # ONE descending sort serves both per-row filters, composed
+            # top_k THEN top_p (the HF/vLLM/OpenAI convention, ADVICE r4):
+            # in sorted space top_k keeps exactly columns [0, k), so the
+            # nucleus mass is computed over the top_k-FILTERED renormalized
+            # distribution by masking those columns before the softmax.
+            # A row's 0 disables its filter via the mask terms.
             sorted_desc = jnp.sort(s, axis=-1)[:, ::-1]
             k_idx = jnp.clip(top_k_row.astype(jnp.int32) - 1, 0,
                              V - 1)[:, None]
             kth = jnp.take_along_axis(sorted_desc, k_idx, axis=-1)  # [B,1]
             s = jnp.where((top_k_row[:, None] > 0) & (s < kth), -1e30, s)
-            probs = jax.nn.softmax(sorted_desc, axis=-1)
+            col = jnp.arange(V)[None, :]
+            in_topk = ((top_k_row[:, None] <= 0)
+                       | (col < top_k_row[:, None].astype(jnp.int32)))
+            sorted_masked = jnp.where(in_topk, sorted_desc, -1e30)
+            probs = jax.nn.softmax(sorted_masked, axis=-1)
             cumulative = jnp.cumsum(probs, axis=-1)
             cutoff_idx = jnp.sum(cumulative < top_p_row[:, None], axis=-1,
                                  keepdims=True)
-            cutoff = jnp.take_along_axis(sorted_desc, cutoff_idx, axis=-1)
+            cutoff = jnp.take_along_axis(sorted_masked, cutoff_idx, axis=-1)
             return jnp.where((top_p_row[:, None] > 0) & (s < cutoff),
                              -1e30, s)
 

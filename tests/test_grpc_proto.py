@@ -204,3 +204,44 @@ def test_grpc_streams_a_real_generation():
     finally:
         server.stop()
         engine.stop()
+
+
+def test_grpc_validation_errors_map_to_invalid_argument():
+    """ADVICE r4: client-input errors (ValueError / InvalidParam raised by
+    handlers) must abort INVALID_ARGUMENT, not INTERNAL — gRPC clients
+    need to tell bad requests from server faults, like the HTTP 400/500
+    split. Covers unary and the lazily-raising stream path."""
+    import grpc as grpc_mod
+
+    from gofr_tpu.http.errors import InvalidParam
+
+    def bad_unary(ctx):
+        raise ValueError("empty prompt")
+
+    def broken_unary(ctx):
+        raise RuntimeError("engine on fire")
+
+    def bad_stream(ctx):
+        raise InvalidParam(["top_p"])
+        yield  # pragma: no cover
+
+    service = GenericService(
+        "val.Svc", {"Bad": bad_unary, "Broken": broken_unary},
+        stream_methods={"BadStream": bad_stream})
+    server = GRPCServer(_Container(), port=0, logger=MockLogger())
+    server.register(service)
+    server.start()
+    try:
+        client = GRPCClient(f"127.0.0.1:{server.port}")
+        with pytest.raises(grpc_mod.RpcError) as err:
+            client.call("val.Svc", "Bad", {"x": 1})
+        assert err.value.code() == grpc_mod.StatusCode.INVALID_ARGUMENT
+        with pytest.raises(grpc_mod.RpcError) as err:
+            client.call("val.Svc", "Broken", {"x": 1})
+        assert err.value.code() == grpc_mod.StatusCode.INTERNAL
+        with pytest.raises(grpc_mod.RpcError) as err:
+            list(client.stream("val.Svc", "BadStream", {"x": 1}))
+        assert err.value.code() == grpc_mod.StatusCode.INVALID_ARGUMENT
+        client.close()
+    finally:
+        server.stop()

@@ -194,3 +194,36 @@ def test_control_row_clears_when_slot_frees():
         assert (controls == 0.0).all(), controls
     finally:
         eng.stop()
+
+
+def test_row_top_k_then_top_p_composition():
+    """ADVICE r4: when a row sets BOTH filters, the nucleus mass must be
+    computed over the top_k-FILTERED renormalized distribution (HF/vLLM
+    composition). Construct logits where the two orders provably differ:
+    probs ~ [0.4, 0.3, 0.2, 0.1]; top_k=2 renormalizes to [0.571, 0.429];
+    top_p=0.5 must then keep ONLY token 0 (0.571 >= 0.5) — whereas top_p
+    over the unfiltered distribution keeps tokens {0, 1} (0.4 < 0.5).
+    Sampling at any seed must therefore always return token 0."""
+    p = np.array([0.4, 0.3, 0.2, 0.1] + [1e-9] * 60)
+    logits = jnp.asarray(np.log(p / p.sum()), dtype=jnp.float32)[None, :]
+    samp = jnp.asarray(pack_controls(temperature=[1.0], top_p=[0.5],
+                                     top_k=[2]))
+    rng = jax.random.PRNGKey(0)
+    for _ in range(20):
+        toks, rng = sample_tokens(logits, rng, samp)
+        assert int(toks[0]) == 0
+
+
+def test_row_top_p_alone_keeps_small_prefix():
+    """Same distribution, top_p=0.5 with no top_k: nucleus over the raw
+    distribution is {0, 1} (0.4 < 0.5 <= 0.7) — token 2 never samples."""
+    p = np.array([0.4, 0.3, 0.2, 0.1] + [1e-9] * 60)
+    logits = jnp.asarray(np.log(p / p.sum()), dtype=jnp.float32)[None, :]
+    samp = jnp.asarray(pack_controls(temperature=[1.0], top_p=[0.5],
+                                     top_k=[0]))
+    rng = jax.random.PRNGKey(0)
+    seen = set()
+    for _ in range(40):
+        toks, rng = sample_tokens(logits, rng, samp)
+        seen.add(int(toks[0]))
+    assert seen <= {0, 1} and 0 in seen
