@@ -282,3 +282,38 @@ def test_zero_draft_verify_falls_back_to_block_decode():
             float(eng.speculative_tokens))
     finally:
         eng.stop()
+
+
+def test_speculative_composes_with_prefix_cache():
+    """VERDICT r4 weak #4: the verify gather reading SHARED read-only
+    prefix pages while other slots hold refs. Shared-prefix traffic
+    through a speculative prefix-cached engine must be token-for-token
+    equal to the plain dense engine, hit the cache, and leak nothing."""
+    system = list(range(60, 60 + 32))  # two full 16-token pages of prefix
+    prompts = [system + [40 + i, 41 + i, 42 + i] for i in range(4)]
+    want = _serve(prompts, max_new=20, spec=0)
+
+    params = llama_init(CFG, seed=0)
+    eng = PagedLLMEngine(params, CFG, n_slots=4, max_seq_len=128,
+                         prefill_buckets=(8, 32, 64), page_size=16,
+                         decode_block_size=4, speculative_tokens=4,
+                         prefix_cache=True)
+    eng.start()
+    try:
+        # wave 1 concurrently (sharers ref the same pages mid-verify),
+        # wave 2 after (hits pages wave 1 inserted)
+        reqs = [eng.submit(p, max_new_tokens=20, temperature=0.0)
+                for p in prompts]
+        got = [r.result(timeout_s=300) for r in reqs]
+        reqs2 = [eng.submit(p, max_new_tokens=20, temperature=0.0)
+                 for p in prompts]
+        got2 = [r.result(timeout_s=300) for r in reqs2]
+        assert eng.prefix.hit_pages > 0, "prefix never hit under spec"
+    finally:
+        eng.stop()
+    assert got == want
+    assert got2 == want
+    # zero leaked/over-released pages: every page not owned by the prefix
+    # cache is back on the free list, and cached pages all sit at refs==0
+    assert eng.allocator.used_pages == eng.prefix.resident_pages
+    assert all(r == 0 for r in eng.prefix._refs.values())

@@ -62,9 +62,12 @@ def _build(profile: str, preset: str):
         return PagedLLMEngine(params, cfg, page_size=16 if small else 128,
                               prefix_cache=True, **kw)
     if profile == "spec":
+        # prefix_cache=True on purpose: the verify gather reading shared
+        # read-only pages while other slots hold refs is exactly the
+        # composition the soak must hammer (VERDICT r4 weak #4)
         params = llama_init(cfg, seed=0)
         return PagedLLMEngine(params, cfg, page_size=16 if small else 128,
-                              speculative_tokens=4, **kw)
+                              speculative_tokens=4, prefix_cache=True, **kw)
     raise SystemExit(f"unknown profile {profile!r}")
 
 
@@ -74,13 +77,23 @@ def _soak(engine, seconds: float, n_threads: int, vocab: int) -> dict:
     lock = threading.Lock()
     stop_at = time.time() + seconds
 
+    # a SHARED system prefix (same across workers, longer than a page) so
+    # prefix-cached engines actually share pages under concurrent load —
+    # random-only traffic would insert but never hit, leaving the
+    # spec-verify-over-shared-pages composition unexercised
+    shared_prefix = [((7 * i) % (vocab - 1)) + 1 for i in range(40)]
+
     def worker(idx: int) -> None:
         rng = random.Random(1000 + idx)
         while time.time() < stop_at:
-            periodic = rng.random() < 0.5
-            if periodic:  # self-repetitive: the speculative fast path
+            kind = rng.random()
+            if kind < 0.35:  # self-repetitive: the speculative fast path
                 unit = [rng.randrange(1, vocab) for _ in range(3)]
                 prompt = (unit * 8)[:rng.choice([6, 12, 24, 40])]
+            elif kind < 0.65:  # shared-prefix: the prefix-cache fast path
+                tail = [rng.randrange(1, vocab)
+                        for _ in range(rng.choice([2, 5, 11]))]
+                prompt = shared_prefix + tail
             else:
                 prompt = [rng.randrange(1, vocab)
                           for _ in range(rng.choice([3, 9, 20, 45]))]
