@@ -12,12 +12,16 @@ protoc-generated stubs). Handlers receive a Context whose request carries
 the deserialized message — the same handler shape as HTTP. Objects exposing
 `__grpc_service_name__` and `__grpc_methods__` register identically.
 
-SERVER-STREAMING RPCs (reference grpc.go registers arbitrary protoc
-services, streaming included): `stream_methods` handlers return an
-ITERATOR of responses; each item is serialized and sent as one stream
-message — how token generation travels over gRPC with the same chunk
-payloads as the SSE surface (examples/llm-server registers one).
-GRPCClient.stream() is the consuming counterpart.
+ALL FOUR RPC SHAPES register (reference grpc.go:20-46 hosts arbitrary
+protoc services, every shape included):
+  - unary: `methods` — handler returns one response
+  - server-streaming: `stream_methods` — handler returns an ITERATOR;
+    each item is one stream message (how token generation travels over
+    gRPC with the same chunk payloads as SSE; examples/llm-server)
+  - client-streaming: `client_stream_methods` — ctx.request.payload is
+    the iterator of inbound messages; handler aggregates to one response
+  - bidi: `bidi_methods` — inbound iterator in, handler yields out
+GRPCClient.call/stream/client_stream/bidi are the consuming counterparts.
 """
 
 from __future__ import annotations
@@ -86,12 +90,23 @@ class GenericService:
                  serializer: Optional[Callable[[Any], bytes]] = None,
                  deserializer: Optional[Callable[[bytes], Any]] = None,
                  stream_methods: Optional[Dict[str, Callable[[Context], Any]]]
+                 = None,
+                 client_stream_methods: Optional[Dict[str, Callable[[Context],
+                                                                    Any]]]
+                 = None,
+                 bidi_methods: Optional[Dict[str, Callable[[Context], Any]]]
                  = None):
         self.__grpc_service_name__ = name
         self.__grpc_methods__ = methods
         # server-streaming: handler returns an iterator; each item goes
         # through the serializer as one stream message
         self.__grpc_stream_methods__ = stream_methods or {}
+        # client-streaming: ctx.request.payload is an ITERATOR of
+        # deserialized messages; the handler consumes it and returns one
+        # response. bidi: same inbound iterator, handler YIELDS responses
+        # (free interleaving — grpc delivers each yield as it happens)
+        self.__grpc_client_stream_methods__ = client_stream_methods or {}
+        self.__grpc_bidi_methods__ = bidi_methods or {}
         self.serializer = serializer or (lambda obj: json.dumps(obj, default=str).encode())
         self.deserializer = deserializer or (lambda raw: json.loads(raw.decode()) if raw else {})
 
@@ -124,6 +139,22 @@ class GRPCServer:
                                        {}).items():
             handlers[method_name] = grpc.unary_stream_rpc_method_handler(
                 self._adapt_stream(f"/{name}/{method_name}", fn, serializer),
+                request_deserializer=deserializer,
+                response_serializer=lambda b: b,
+            )
+        for method_name, fn in getattr(service,
+                                       "__grpc_client_stream_methods__",
+                                       {}).items():
+            handlers[method_name] = grpc.stream_unary_rpc_method_handler(
+                self._adapt_client_stream(f"/{name}/{method_name}", fn,
+                                          serializer),
+                request_deserializer=deserializer,
+                response_serializer=lambda b: b,
+            )
+        for method_name, fn in getattr(service, "__grpc_bidi_methods__",
+                                       {}).items():
+            handlers[method_name] = grpc.stream_stream_rpc_method_handler(
+                self._adapt_bidi(f"/{name}/{method_name}", fn, serializer),
                 request_deserializer=deserializer,
                 response_serializer=lambda b: b,
             )
@@ -210,6 +241,79 @@ class GRPCServer:
 
         return handle
 
+    def _adapt_client_stream(self, full_method: str, fn, serializer):
+        """Client-streaming: the request payload IS the (lazily consumed)
+        iterator of deserialized messages; the handler aggregates and
+        returns one response. Completes the RPC-shape matrix the reference
+        gets from protoc service registration (grpc.go:20-46)."""
+        def handle(request_iterator, grpc_ctx):
+            start = time.time()
+            metadata = {k: v for k, v in (grpc_ctx.invocation_metadata() or [])}
+            request = GRPCRequest(request_iterator, full_method, metadata)
+            span = None
+            if self.container.tracer is not None:
+                span = self.container.tracer.start_span(
+                    f"grpc {full_method}",
+                    traceparent=metadata.get("traceparent"))
+                request.span = span
+            ctx = Context(request=request, container=self.container)
+            status = "OK"
+            try:
+                return serializer(fn(ctx))
+            except Exception as exc:  # noqa: BLE001 - recovery interceptor
+                status = "ERROR"
+                self.logger.errorf("grpc client-stream %s failed: %s",
+                                   full_method, exc)
+                grpc_ctx.abort(self._status_for(exc), str(exc))
+            finally:
+                duration_us = int((time.time() - start) * 1e6)
+                trace_id = span.trace_id if span else ""
+                self.logger.info(RPCLog(full_method, status, duration_us,
+                                        trace_id))
+                if span is not None:
+                    span.set_status(status == "OK")
+                    span.end()
+
+        return handle
+
+    def _adapt_bidi(self, full_method: str, fn, serializer):
+        """Bidirectional streaming: inbound iterator as the payload, the
+        handler yields responses whenever it likes (echo-per-message,
+        batch-then-flush, or fully decoupled)."""
+        def handle(request_iterator, grpc_ctx):
+            start = time.time()
+            metadata = {k: v for k, v in (grpc_ctx.invocation_metadata() or [])}
+            request = GRPCRequest(request_iterator, full_method, metadata)
+            span = None
+            if self.container.tracer is not None:
+                span = self.container.tracer.start_span(
+                    f"grpc {full_method}",
+                    traceparent=metadata.get("traceparent"))
+                request.span = span
+            ctx = Context(request=request, container=self.container)
+            status = "OK"
+            sent = 0
+            try:
+                for item in fn(ctx):
+                    yield serializer(item)
+                    sent += 1
+            except Exception as exc:  # noqa: BLE001 - recovery interceptor
+                status = "ERROR"
+                self.logger.errorf("grpc bidi %s failed after %d messages: %s",
+                                   full_method, sent, exc)
+                grpc_ctx.abort(self._status_for(exc), str(exc))
+            finally:
+                duration_us = int((time.time() - start) * 1e6)
+                trace_id = span.trace_id if span else ""
+                self.logger.info(RPCLog(f"{full_method} [{sent} msgs]",
+                                        status, duration_us, trace_id))
+                if span is not None:
+                    span.set_attribute("grpc.stream_messages", sent)
+                    span.set_status(status == "OK")
+                    span.end()
+
+        return handle
+
     def start(self) -> None:
         bound = self._server.add_insecure_port(f"0.0.0.0:{self.port}")
         if self.port == 0:
@@ -262,6 +366,42 @@ class GRPCClient:
         )
         md = list((metadata or {}).items())
         return fn(payload, timeout=timeout_s, metadata=md)
+
+    def client_stream(self, service: str, method: str, payloads,
+                      timeout_s: float = 30.0,
+                      metadata: Optional[Dict[str, str]] = None,
+                      serializer: Optional[Callable[[Any], bytes]] = None,
+                      deserializer: Optional[Callable[[bytes], Any]] = None
+                      ) -> Any:
+        """Client-streaming call: sends every item of `payloads` (any
+        iterable), returns the server's single aggregated response."""
+        fn = self.channel.stream_unary(
+            f"/{service}/{method}",
+            request_serializer=serializer or (
+                lambda obj: json.dumps(obj, default=str).encode()),
+            response_deserializer=deserializer or (
+                lambda raw: json.loads(raw.decode()) if raw else None),
+        )
+        md = list((metadata or {}).items())
+        return fn(iter(payloads), timeout=timeout_s, metadata=md)
+
+    def bidi(self, service: str, method: str, payloads,
+             timeout_s: float = 30.0,
+             metadata: Optional[Dict[str, str]] = None,
+             serializer: Optional[Callable[[Any], bytes]] = None,
+             deserializer: Optional[Callable[[bytes], Any]] = None):
+        """Bidirectional call: sends `payloads` (any iterable — a generator
+        can block to interleave with responses) and yields the server's
+        messages as they arrive."""
+        fn = self.channel.stream_stream(
+            f"/{service}/{method}",
+            request_serializer=serializer or (
+                lambda obj: json.dumps(obj, default=str).encode()),
+            response_deserializer=deserializer or (
+                lambda raw: json.loads(raw.decode()) if raw else None),
+        )
+        md = list((metadata or {}).items())
+        return fn(iter(payloads), timeout=timeout_s, metadata=md)
 
     def close(self) -> None:
         self.channel.close()
