@@ -724,11 +724,20 @@ class LLMEngine:
             time.sleep(0.05)
         return False
 
-    def warmup(self, grow: bool = True) -> None:
+    def warmup(self, grow: bool = True, k_variants: bool = False) -> None:
         """Pre-compile single-admission prefill buckets and the decode
         program. Programs for grown cache sizes (and batched-K prefill
         variants) compile on first use — one ~1s hiccup per power-of-two
         growth over the engine's lifetime.
+
+        k_variants=True additionally compiles EVERY power-of-two fused-
+        admission width K <= n_slots per bucket. Organic (staggered)
+        arrivals admit in unpredictable group sizes, so without this a
+        production server pays a first-use compile mid-request whenever
+        traffic first produces a new (bucket, K) — the TTFT spike the
+        HTTP-boundary bench phase exposed. Costs buckets x log2(slots)
+        compiles at boot, amortized to zero by the persistent program
+        cache.
 
         grow=True (server boot) grows the cache to cover the largest prefill
         bucket up front so no request pays a growth copy; grow=False grows
@@ -752,15 +761,28 @@ class LLMEngine:
                 # routed to the chunk path skip the (dead) fused program
                 if bucket <= self._cache_len and not (chunk and bucket > chunk):
                     self._prefill_program(bucket, 1)
+                    if k_variants:
+                        K = 2
+                        while K <= self.n_slots:
+                            self._prefill_program(bucket, K)
+                            K *= 2
                     if self.logger is not None:
                         self.logger.debugf("warmed prefill bucket %d", bucket)
             if chunk and any(b > chunk for b in self.prefill_buckets):
                 # chunk-program shapes depend on (chunk, K) only; warm the
                 # first/middle/final variants the first long prompt hits
-                self._chunk_program(chunk, 1, first=True, final=False)
-                self._chunk_program(chunk, 1, first=False, final=True)
-                if any(b > 2 * chunk for b in self.prefill_buckets):
-                    self._chunk_program(chunk, 1, first=False, final=False)
+                ks = [1]
+                if k_variants:
+                    K = 2
+                    while K <= self.n_slots:
+                        ks.append(K)
+                        K *= 2
+                for K in ks:
+                    self._chunk_program(chunk, K, first=True, final=False)
+                    self._chunk_program(chunk, K, first=False, final=True)
+                    if any(b > 2 * chunk for b in self.prefill_buckets):
+                        self._chunk_program(chunk, K, first=False,
+                                            final=False)
             if self.speculative_tokens:
                 self._verify_program()
             # adaptive cooloff (spec mode) falls back to exactly these

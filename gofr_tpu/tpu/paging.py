@@ -311,14 +311,24 @@ class PagedLLMEngine(LLMEngine):
         self._obs.gauge("app_tpu_pages_used", self.allocator.used_pages)
 
     # -- programs -------------------------------------------------------------
-    def warmup(self, grow: bool = True) -> None:
+    def warmup(self, grow: bool = True, k_variants: bool = False) -> None:
         with self._state_lock:
+            ks = [1]
+            if k_variants:
+                # every power-of-two fused-admission width: organic
+                # staggered traffic admits in unpredictable group sizes
+                # (see the dense warmup's rationale)
+                K = 2
+                while K <= self.n_slots:
+                    ks.append(K)
+                    K *= 2
             chunk = self.chunk_prefill_tokens
             for bucket in self.prefill_buckets:
                 # buckets routed to the chunk path skip the (dead) fused
                 # program, mirroring the dense warmup's routing
                 if not (chunk and bucket > chunk):
-                    self._prefill_program(bucket, 1)
+                    for K in ks:
+                        self._prefill_program(bucket, K)
             if chunk:
                 for bucket in self.prefill_buckets:
                     if bucket > chunk:  # warm that bucket's mid+final pair
