@@ -13,9 +13,8 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
 from gofr_tpu import App  # noqa: E402
 
 
-def main() -> None:
-    os.chdir(os.path.dirname(os.path.abspath(__file__)))
-    app = App()
+def build_app(config=None) -> App:
+    app = App(config=config)
 
     @app.get("/hello")
     def hello(ctx):
@@ -34,7 +33,12 @@ def main() -> None:
     def error(ctx):
         raise RuntimeError("deliberate failure")
 
-    app.run()
+    return app
+
+
+def main() -> None:
+    os.chdir(os.path.dirname(os.path.abspath(__file__)))
+    build_app().run()
 
 
 if __name__ == "__main__":
