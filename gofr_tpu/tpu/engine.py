@@ -273,6 +273,7 @@ class LLMEngine:
         chunk_prefill_tokens: int = 0,
         speculative_tokens: int = 0,
         sampling_controls: bool = False,
+        admission_plane=None,
     ):
         """mesh: optional jax.sharding.Mesh with a "tp" axis. When given, the
         engine serves TENSOR-PARALLEL: params shard per serving_param_specs
@@ -458,6 +459,14 @@ class LLMEngine:
         self._admission_heap: List[tuple] = []
         self._wake = threading.Event()
         self._stop = threading.Event()
+        # live-traffic multi-host admission (tpu.admission.AdmissionPlane):
+        # rank 0 publishes each wave's composition over the coordination
+        # KV plane, followers replay it — every rank issues the identical
+        # SPMD dispatch sequence without the pre-queued determinism
+        # contract. None = single-controller serving, zero overhead.
+        self._plane = admission_plane
+        if admission_plane is not None:
+            admission_plane.stop_event = self._stop
         # drain(): reject new work, let active generations finish
         self._draining = False
         self._thread: Optional[threading.Thread] = None
@@ -641,6 +650,12 @@ class LLMEngine:
             raise RuntimeError("engine is stopped")
         if self._draining:
             raise EngineDrainingError()
+        if self._plane is not None and not self._plane.is_leader:
+            # multi-controller serving has ONE ingress: rank 0 composes
+            # every admission wave; this rank only replays them
+            raise RuntimeError(
+                "this rank mirrors admission waves from the leader; "
+                "submit on process 0")
         if not prompt_tokens:
             raise ValueError("prompt_tokens must be non-empty")
         if (top_p or top_k) and not self.sampling_controls:
@@ -691,6 +706,10 @@ class LLMEngine:
         if self._thread is not None:
             self._thread.join(timeout=30)
             self._thread = None
+        if self._plane is not None:
+            # leader: publish the stop sentinel AFTER the loop exits (no
+            # further waves can race it) so parked followers unblock
+            self._plane.close()
         self._drain_pending(RuntimeError("engine stopped"))
 
     def drain(self, timeout_s: float = 30.0) -> bool:
@@ -1086,7 +1105,7 @@ class LLMEngine:
         if not self._chunk_jobs:
             return
         job = self._chunk_jobs[0]
-        if all(r.cancelled.is_set() for r in job["batch"]):
+        if all(self._is_cancelled(r) for r in job["batch"]):
             self._abort_chunk_job(job, None)
             self._chunk_jobs.popleft()
             return
@@ -1449,16 +1468,20 @@ class LLMEngine:
         behind interleaved decode blocks), so unlimited is the default.
         With chunk_prefill_tokens set, buckets larger than the chunk size
         go through the chunk-job path instead of one fused dispatch."""
-        if self._draining:
+        if self._draining and self._plane is None:
             # drain() already failed the queue; anything racing in after
             # that must not start generating on a server that is going away
+            # (multi-controller: the drain must ride a wave instead — the
+            # heap clear has to land on every rank at the same iteration)
             self._drain_pending(EngineDrainingError())
             return
         free = [i for i, slot in enumerate(self.slots)
                 if not slot.active and slot.chunking is None]
-        if not free:
+        if not free and self._plane is None:
             return
-        cap = min(len(free), self.max_prefill_batch or len(free))
+        # multi-controller: the wave exchange must run even with zero free
+        # slots — cancels and the drain flag ride waves, and a saturated
+        # server is exactly where cancellation must still free capacity
         # ONE priority-ordered admission heap: arrivals from _pending merge
         # with requests parked earlier on a subclass resource (pages).
         # Heap order (priority, id) means a later higher-priority request
@@ -1469,17 +1492,73 @@ class LLMEngine:
         # resource it is waiting for.
         import heapq
 
+        drained: List[tuple] = []
         while True:
             try:
-                heapq.heappush(self._admission_heap,
-                               self._pending.get_nowait())
+                drained.append(self._pending.get_nowait())
             except queue.Empty:
                 break
+        if self._plane is not None:
+            if self._draining and drained:
+                # a draining leader's local arrivals never enter a wave
+                exc = EngineDrainingError()
+                for _, _, request in drained:
+                    self._fail_request(request, exc)
+                drained = []
+            # one wave per iteration: the leader freezes this iteration's
+            # arrivals (+ cancels + the drain flag) and publishes;
+            # followers block for the same wave. has_work must be computed
+            # from MIRRORED state only — it decides whether a wave exists
+            # at all, so every rank must agree — and it means work that
+            # can DISPATCH this iteration: active/chunking slots, programs
+            # in flight, and heap-parked requests that now have a free
+            # slot (admitting those dispatches an SPMD prefill, so a wave
+            # must pace it or followers would still be parked in the KV
+            # wait when the collective needs them). A parked request with
+            # NO free slot doesn't count: counting it would flood empty
+            # waves at loop speed with no collective backpressure bounding
+            # the leader's lead over a stalled follower, and nothing can
+            # unpark it except a slot freeing (a dispatching iteration) or
+            # the composition change the next wave delivers.
+            has_work = (any(s.active or s.chunking is not None
+                            for s in self.slots)
+                        or bool(self._inflight) or bool(self._chunk_jobs)
+                        or (bool(self._admission_heap) and bool(free)))
+            try:
+                drained, drain_synced = self._plane.exchange(
+                    drained, has_work, draining=self._draining)
+            except Exception as exc:
+                # the popped arrivals are in no queue, no heap, no slot —
+                # fail them here or their clients block forever (the
+                # loop's reset path only fails ACTIVE slots)
+                for _, _, request in drained:
+                    self._fail_request(request, exc)
+                raise
+            if self._plane.closed and not self._plane.is_leader:
+                # the leader published its stop sentinel: no collective
+                # this rank dispatches can ever complete again. Stop at
+                # THIS iteration — fail actives loudly, never hang the
+                # slice on a half-membership psum.
+                self._stop.set()
+                raise RuntimeError(
+                    "admission leader stopped; follower cannot make "
+                    "progress without its collective peer")
+            if drain_synced:
+                # the drain lands on every rank at THIS wave: parked heap
+                # entries fail here, symmetrically, and nothing admits
+                self._draining = True
+                self._drain_pending(EngineDrainingError())
+                return
+        for entry in drained:
+            heapq.heappush(self._admission_heap, entry)
+        if not free:
+            return  # saturated: entries stay parked for the next free slot
+        cap = min(len(free), self.max_prefill_batch or len(free))
         taken: List[GenerationRequest] = []
         while self._admission_heap and len(taken) < cap:
             entry = heapq.heappop(self._admission_heap)
             request = entry[2]
-            if request.cancelled.is_set():
+            if self._is_cancelled(request):
                 self._abort_admission(request)
                 self._fail_request(request)
                 continue
@@ -1667,7 +1746,12 @@ class LLMEngine:
         -34% decode throughput but -66% p50 TTFT under Poisson load; the
         adaptive switch pays the short-block cost only under queue
         pressure)."""
-        if self._pending.qsize() or self._admission_heap:
+        # multi-controller: _pending is leader-local (a submit racing in
+        # after this iteration's wave is invisible to followers), so only
+        # the mirrored heap may influence the block size — a rank-local
+        # block choice would dispatch mismatched SPMD programs
+        if self._admission_heap or (self._plane is None
+                                    and self._pending.qsize()):
             return max(1, self.decode_block_size // 2)
         return self.decode_block_size
 
@@ -1731,7 +1815,7 @@ class LLMEngine:
                     slot.history = list(request.prompt_tokens) + [token]
                 self._emit(request, token)
                 if (request.hit_stop(token) or slot.remaining <= 0
-                        or request.cancelled.is_set()):
+                        or self._is_cancelled(request)):
                     self._finish_slot(slot)
             return
 
@@ -1772,7 +1856,7 @@ class LLMEngine:
                     self._emit(request, token)
                     emitted += 1
                     if (request.hit_stop(token) or slot.remaining <= 0
-                            or request.cancelled.is_set()
+                            or self._is_cancelled(request)
                             or slot.length >= self.max_seq_len - 1):
                         self._finish_slot(slot)
                         break
@@ -1831,7 +1915,7 @@ class LLMEngine:
                 self._emit(request, token)
                 emitted += 1
                 if (request.hit_stop(token) or slot.remaining <= 0
-                        or request.cancelled.is_set()
+                        or self._is_cancelled(request)
                         or slot.length >= self.max_seq_len - 1):
                     self._finish_slot(slot)
                     break
@@ -1847,6 +1931,9 @@ class LLMEngine:
         its generation span and unblock its consumer."""
         if exc is not None:
             request.error = exc
+        if request.finished_at is None:  # terminal either way: consumers
+            request.finished_at = time.time()  # and the admission plane's
+            # live-registry prune both treat this request as over
         if request.gen_span is not None and request.gen_span.end_time is None:
             if request.error is not None:
                 request.gen_span.set_status(False, str(request.error))
@@ -1906,6 +1993,16 @@ class LLMEngine:
                     slot.request.error = exc
                     self._finish_slot(slot)
             self._init_device_state()
+
+    def _is_cancelled(self, request: GenerationRequest) -> bool:
+        """Cancellation as the DISPATCH path must see it. Single-controller:
+        the live event. Multi-controller: membership in the plane's synced
+        set — a cancel takes effect only at the wave that broadcast it, so
+        every rank frees the slot at the same loop iteration (a rank-local
+        early free would desynchronize the SPMD dispatch sequence)."""
+        if self._plane is not None:
+            return request.id in self._plane.synced_cancelled
+        return request.cancelled.is_set()
 
     def _admission_ready(self, request: GenerationRequest) -> bool:
         """Subclass hook: reserve per-request resources (pages) before the
