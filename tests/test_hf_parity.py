@@ -1,0 +1,198 @@
+"""Cross-validation against HuggingFace transformers (the de-facto oracle).
+
+Every other weights test round-trips through this repo's OWN safetensors
+writer — that proves reader==writer, not that the importer understands real
+HF checkpoints. Here the fixture is produced by `LlamaForCausalLM.save_pretrained`
+itself (genuine HF tensor names, layout, rope convention), and the loaded
+model is logits-matched against transformers' forward pass. This is the
+closest available stand-in for "boots actual Llama-3 weights" in a
+zero-egress environment: the 8B checkpoint differs from this fixture only
+in shape constants, not in format or convention.
+
+Covers the classic importer failure modes that a self-roundtrip can never
+catch: q/k head permutation (HF conversion pre-permutes for rotate-half
+RoPE — loading real HF weights must NOT permute again), [out,in] vs
+[in,out] projection transposes, norm placement/eps, GQA head mapping, and
+tied-embedding handling.
+
+Also cross-checks the byte-level BPE tokenizer against the `tokenizers`
+library (the engine under HF's tokenizer.json) on the same vocab file.
+
+Parity anchor: the reference pins its serialization against real wire
+formats rather than its own mirrors (protoc-generated stubs in the gRPC
+tests, /root/reference/pkg/gofr/grpc.go:20-46); transformers plays that
+role for checkpoint bytes here.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+from gofr_tpu.models.llama import LlamaConfig, init_kv_cache, llama_prefill
+from gofr_tpu.models.weights import load_llama_safetensors
+
+# Small but non-degenerate: GQA (4 q-heads over 2 kv-heads), head_dim 16,
+# an MLP width that is not a multiple of the hidden size, Llama-3's
+# rope_theta.
+DIM, LAYERS, HEADS, KV_HEADS, FFN, VOCAB = 64, 2, 4, 2, 160, 256
+
+
+def _hf_model(tie: bool):
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=VOCAB, hidden_size=DIM, intermediate_size=FFN,
+        num_hidden_layers=LAYERS, num_attention_heads=HEADS,
+        num_key_value_heads=KV_HEADS, max_position_embeddings=128,
+        rms_norm_eps=1e-5, rope_theta=500000.0, attention_bias=False,
+        mlp_bias=False, tie_word_embeddings=tie)
+    torch.manual_seed(7)
+    model = transformers.LlamaForCausalLM(hf_cfg)
+    return model.to(torch.float32).eval()
+
+
+def _our_cfg():
+    return LlamaConfig(vocab_size=VOCAB, dim=DIM, n_layers=LAYERS,
+                       n_heads=HEADS, n_kv_heads=KV_HEADS, ffn_dim=FFN,
+                       max_seq_len=128, rope_theta=500000.0, rms_eps=1e-5,
+                       dtype="float32")
+
+
+def _our_logits(params, cfg, tokens_np):
+    import jax.numpy as jnp
+
+    tokens = jnp.asarray(tokens_np, dtype=jnp.int32)
+    B, T = tokens.shape
+    k, v = init_kv_cache(cfg, B, T)
+    logits, _, _ = llama_prefill(params, cfg, tokens, k, v)
+    return np.asarray(logits, dtype=np.float32)
+
+
+@pytest.mark.parametrize("tie", [False, True], ids=["untied", "tied"])
+def test_logits_match_transformers(tmp_path, tie):
+    model = _hf_model(tie)
+    ckpt = tmp_path / "ckpt"
+    model.save_pretrained(ckpt, safe_serialization=True)
+
+    cfg = _our_cfg()
+    params = load_llama_safetensors(cfg, str(ckpt))
+
+    rng = np.random.default_rng(11)
+    tokens = rng.integers(1, VOCAB, size=(2, 24))
+    with torch.no_grad():
+        ref = model(torch.tensor(tokens, dtype=torch.long)).logits.numpy()
+    got = _our_logits(params, cfg, tokens)
+
+    assert got.shape == ref.shape
+    # Both sides compute norms/softmax/logits in fp32; residual-order and
+    # fusion differences leave ~1e-5 noise at this scale.
+    np.testing.assert_allclose(got, ref, rtol=1e-3, atol=2e-4)
+
+
+def test_greedy_continuation_matches_transformers(tmp_path):
+    """Teacher-forced parity can hide compounding drift; greedy decode is
+    the serving-shaped claim: both stacks produce the same continuation."""
+    import jax.numpy as jnp
+
+    model = _hf_model(False)
+    ckpt = tmp_path / "ckpt"
+    model.save_pretrained(ckpt, safe_serialization=True)
+    cfg = _our_cfg()
+    params = load_llama_safetensors(cfg, str(ckpt))
+
+    rng = np.random.default_rng(5)
+    prompt = rng.integers(1, VOCAB, size=(1, 8))
+    steps = 16
+
+    with torch.no_grad():
+        ref = model.generate(
+            torch.tensor(prompt, dtype=torch.long), max_new_tokens=steps,
+            do_sample=False, use_cache=True,
+            pad_token_id=0).numpy()[0, prompt.shape[1]:]
+
+    seq = jnp.asarray(prompt, dtype=jnp.int32)
+    ours = []
+    for _ in range(steps):
+        T = seq.shape[1]
+        k, v = init_kv_cache(cfg, 1, max(T, 16))
+        logits, _, _ = llama_prefill(params, cfg, seq, k, v)
+        nxt = int(np.asarray(logits)[0, -1].argmax())
+        ours.append(nxt)
+        seq = jnp.concatenate(
+            [seq, jnp.asarray([[nxt]], dtype=jnp.int32)], axis=1)
+
+    assert ours == ref.tolist()
+
+
+def test_loader_tolerates_hf_config_artifacts(tmp_path):
+    """save_pretrained writes config.json/generation_config.json next to the
+    weights; directory-form loading must key off the safetensors files only."""
+    model = _hf_model(False)
+    ckpt = tmp_path / "ckpt"
+    model.save_pretrained(ckpt, safe_serialization=True)
+    names = {p.name for p in ckpt.iterdir()}
+    assert "config.json" in names  # the fixture really is an HF directory
+    params = load_llama_safetensors(_our_cfg(), str(ckpt))
+    assert params["tok_emb"].shape == (VOCAB, DIM)
+
+
+# The pre-tokenization pattern real Llama-3 tokenizer.json files declare
+# (transcribed from the public release; the module must agree or every
+# VOCAB_PATH deployment mis-splits).
+_LLAMA3_PATTERN = (r"(?i:'s|'t|'re|'ve|'m|'ll|'d)|[^\r\n\p{L}\p{N}]?\p{L}+"
+                   r"|\p{N}{1,3}| ?[^\s\p{L}\p{N}]+[\r\n]*|\s*[\r\n]+"
+                   r"|\s+(?!\S)|\s+")
+
+
+def test_split_pattern_matches_llama3_release():
+    from gofr_tpu.models import tokenizer as tok_mod
+
+    assert tok_mod._LLAMA3_SPLIT == _LLAMA3_PATTERN
+
+
+def test_tokenizer_matches_tokenizers_library(tmp_path):
+    """Same tokenizer.json, our ByteLevelBPETokenizer vs HF `tokenizers`:
+    identical ids on ASCII, multibyte UTF-8, and merge-heavy repetition.
+
+    The fixture mirrors the real Llama-3 tokenizer.json structure: a
+    Split(llama3-regex, isolated) pre-tokenizer feeding
+    ByteLevel(use_regex=False), byte-level BPE model, specials as
+    added_tokens — so from_tokenizer_json exercises the exact layout a real
+    checkpoint ships."""
+    tokenizers_lib = pytest.importorskip("tokenizers")
+    from tokenizers import Regex, decoders, models, pre_tokenizers, trainers
+
+    from gofr_tpu.models.tokenizer import ByteLevelBPETokenizer
+
+    tok = tokenizers_lib.Tokenizer(models.BPE())
+    tok.pre_tokenizer = pre_tokenizers.Sequence([
+        pre_tokenizers.Split(Regex(_LLAMA3_PATTERN), behavior="isolated"),
+        pre_tokenizers.ByteLevel(add_prefix_space=False, use_regex=False),
+    ])
+    tok.decoder = decoders.ByteLevel()
+    trainer = trainers.BpeTrainer(
+        vocab_size=384, special_tokens=["<|begin_of_text|>", "<|end_of_text|>"],
+        initial_alphabet=pre_tokenizers.ByteLevel.alphabet())
+    corpus = ["the quick brown fox jumps over the lazy dog",
+              "hello world, hello tpu serving framework",
+              "çok güzel ünicode — résumé naïve 日本語 テスト",
+              "it's the model's 123 4567 tokens",
+              "aaaa bbbb aaaa bbbb aaaa"]
+    tok.train_from_iterator(corpus, trainer)
+    path = tmp_path / "tokenizer.json"
+    tok.save(str(path))
+
+    ours = ByteLevelBPETokenizer.from_tokenizer_json(str(path))
+
+    samples = ["the quick brown fox", "hello hello world", "aaaa aaaa bbbb",
+               "résumé 日本語", "it's 12345 tokens", "tabs\tand\nnewlines  x",
+               ""]
+    for text in samples:
+        ref_ids = tok.encode(text).ids
+        got_ids = ours.encode(text, bos=False)
+        assert list(got_ids) == list(ref_ids), (
+            f"{text!r}: ours={got_ids} hf={ref_ids}")
+        assert ours.decode(got_ids) == tok.decode(
+            ref_ids, skip_special_tokens=False)
