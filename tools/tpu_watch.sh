@@ -1,0 +1,34 @@
+#!/bin/bash
+# Tunnel-recovery watcher: probe the single-tenant axon TPU tunnel on a
+# wide interval and run the full bench the moment it answers.
+#
+# Why wide spacing: a probe that hangs and gets killed leaves an
+# uncleanly-dead PJRT client, and the tunnel holds a stale lease for many
+# minutes afterwards — tight probe loops can keep a recovering tunnel
+# wedged. 15 min between attempts lets a lease lapse complete.
+#
+# Usage: tools/tpu_watch.sh [attempts] [budget_s] [logfile]
+set -u
+cd "$(dirname "$0")/.."
+ATTEMPTS=${1:-40}
+BUDGET=${2:-2400}
+LOG=${3:-BENCH_SESSION_r05.log}
+
+for i in $(seq 1 "$ATTEMPTS"); do
+  if timeout 130 python -c "
+import jax
+d = jax.devices()
+import jax.numpy as jnp
+jnp.ones((8,)).sum().block_until_ready()
+assert d[0].platform != 'cpu', d
+" >/dev/null 2>&1; then
+    echo "$(date +%F\ %T) probe $i: tunnel ALIVE — running bench (budget ${BUDGET}s)"
+    BENCH_BUDGET_S="$BUDGET" python bench.py >"$LOG" 2>&1
+    echo "$(date +%F\ %T) bench rc=$? (log: $LOG)"
+    exit 0
+  fi
+  echo "$(date +%F\ %T) probe $i: tunnel still wedged"
+  sleep 900
+done
+echo "$(date +%F\ %T) no recovery within $ATTEMPTS attempts"
+exit 1
