@@ -99,8 +99,13 @@ def _note_wedge(exc, record, where: str) -> bool:
     remaining TPU phase is skipped (each would otherwise burn
     TOKEN_TIMEOUT_S discovering the same dead device), and keep going to
     the final emit. Returns True when exc was a wedge."""
+    from gofr_tpu.tpu.engine import EngineStalledError
+
     global _WEDGED
-    if not (_ON_TPU and isinstance(exc, TimeoutError)):
+    # two surfaces report the same dead device: a result() wait that times
+    # out, and the engine's own stall shed (STALL_REJECT_S=150s fires
+    # before TOKEN_TIMEOUT_S=420s whenever a phase calls submit mid-wedge)
+    if not (_ON_TPU and isinstance(exc, (TimeoutError, EngineStalledError))):
         return False
     _WEDGED = True
     record.update(**{"device_wedged_at": where})
@@ -642,6 +647,12 @@ def main() -> None:
                 _reexec_cpu_fallback(
                     f"device wedged mid-run (no progress for {stalled:.0f}s)")
             if _left() < 45:
+                if _FALLBACK_STARTED:
+                    # a CPU fallback child owns the finish: it has its own
+                    # watchdog bounded by the budget it inherited, and the
+                    # thread that spawned it os._exits when it returns —
+                    # force-exiting here would orphan the child mid-write
+                    continue
                 record.update(watchdog="budget exhausted; last complete "
                                        "record emitted")
                 sys.stdout.flush()

@@ -17,6 +17,12 @@ import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
 
+from gofr_tpu.tpu.device import pin_platform_from_env  # noqa: E402
+
+# honor JAX_PLATFORMS even where sitecustomize force-registers a TPU
+# plugin (a wedged tunnel would otherwise hang boot inside PJRT)
+pin_platform_from_env()
+
 from gofr_tpu import App, Stream  # noqa: E402
 from gofr_tpu.http.errors import InvalidParam  # noqa: E402
 from gofr_tpu.models.llama import LlamaConfig, llama_init  # noqa: E402
@@ -265,6 +271,10 @@ def build_app(config=None, engine=None) -> App:
     elif getattr(engine, "tokenizer", None) is None:
         engine.tokenizer = ByteTokenizer()
     app.engine = engine
+    # /.well-known/health reports the engine next to the datasources: a
+    # wedged device (loop stuck in a PJRT call) degrades the aggregate so
+    # load balancers stop routing here, matching submit()'s 503 shed
+    app.container.add_health_contributor("engine", engine.health_check)
     tokenizer: ByteTokenizer = engine.tokenizer
     # token streaming over gRPC rides the same engine (GRPC_PORT)
     app.register_grpc_service(build_generate_service(engine, tokenizer))
@@ -338,6 +348,7 @@ def build_app(config=None, engine=None) -> App:
             "active_slots": sum(1 for s in engine.slots if s.active),
             "queue_depth": engine._pending.qsize(),
             "compiled_programs": engine.executor.cache_size,
+            "stall_seconds": round(engine.stall_seconds, 1),
         }
         if engine.speculative_tokens:
             out["spec"] = {

@@ -10,6 +10,12 @@ import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
 
+from gofr_tpu.tpu.device import pin_platform_from_env  # noqa: E402
+
+# honor JAX_PLATFORMS even where sitecustomize force-registers a TPU
+# plugin (a wedged tunnel would otherwise hang boot inside PJRT)
+pin_platform_from_env()
+
 import numpy as np  # noqa: E402
 
 from gofr_tpu import App  # noqa: E402

@@ -21,6 +21,12 @@ import uuid
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
 
+from gofr_tpu.tpu.device import pin_platform_from_env  # noqa: E402
+
+# honor JAX_PLATFORMS even where sitecustomize force-registers a TPU
+# plugin (a wedged tunnel would otherwise hang boot inside PJRT)
+pin_platform_from_env()
+
 from gofr_tpu import App, Stream  # noqa: E402
 from gofr_tpu.http.errors import InvalidParam, RequestTimeout  # noqa: E402
 from gofr_tpu.http.responder import Raw  # noqa: E402

@@ -42,6 +42,9 @@ class Container:
         self.tpu = None
         self.docstore = None
         self.services: Dict[str, Any] = {}
+        # app-level components in the aggregate health report (the serving
+        # engines register here; see add_health_contributor)
+        self._health_contributors: Dict[str, Any] = {}
         self.app_name = config.get_or_default("APP_NAME", "gofr-tpu-app")
         self.app_version = config.get_or_default("APP_VERSION", "dev")
         self._started_at = time.time()
@@ -165,6 +168,18 @@ class Container:
         return self.pubsub
 
     # -- aggregate health (container/health.go:39-59) -------------------------
+    def add_health_contributor(self, name: str, fn) -> None:
+        """Register an app-level component in the aggregate health report.
+
+        fn() -> Health (or a dict with a "status" key). The reference's
+        aggregate health covers exactly the datasources the container
+        built; runtime components this framework adds on top (the serving
+        engines, whose failure modes — device wedge, page exhaustion — are
+        invisible to any datasource probe) report through here. DEGRADED
+        contributors degrade the aggregate the same way a DOWN datasource
+        does."""
+        self._health_contributors[name] = fn
+
     def health(self) -> Dict[str, Any]:
         out: Dict[str, Any] = {
             "name": self.app_name,
@@ -193,7 +208,15 @@ class Container:
                 h = Health(status=STATUS_DOWN, details={"error": str(exc)})
             details.setdefault("services", {})[name] = h.to_dict()
             statuses.append(h.status)
-        if any(s == STATUS_DOWN for s in statuses):
+        for name, fn in self._health_contributors.items():
+            try:
+                h = fn()
+            except Exception as exc:  # noqa: BLE001 - a broken probe is DOWN
+                h = Health(status=STATUS_DOWN, details={"error": str(exc)})
+            details[name] = h.to_dict() if isinstance(h, Health) else h
+            statuses.append(h.status if isinstance(h, Health)
+                            else h.get("status", STATUS_DOWN))
+        if any(s in (STATUS_DOWN, STATUS_DEGRADED) for s in statuses):
             out["status"] = STATUS_DEGRADED
         out["details"] = details
         return out

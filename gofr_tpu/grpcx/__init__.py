@@ -165,11 +165,15 @@ class GRPCServer:
         """Client-input errors abort INVALID_ARGUMENT; everything else is a
         server fault (INTERNAL). Mirrors the HTTP surface, where the same
         engine.submit validation raises map to 400 (ADVICE r4): a gRPC
-        client must be able to tell a bad request from a broken server."""
+        client must be able to tell a bad request from a broken server.
+        Shed/overload errors (duck-typed status_code 503: draining engine,
+        wedged device) map to UNAVAILABLE — the retry-elsewhere signal."""
         from ..http.errors import InvalidParam
 
         if isinstance(exc, (ValueError, InvalidParam)):
             return self._grpc.StatusCode.INVALID_ARGUMENT
+        if getattr(exc, "status_code", None) == 503:
+            return self._grpc.StatusCode.UNAVAILABLE
         return self._grpc.StatusCode.INTERNAL
 
     def _adapt(self, full_method: str, fn, serializer):

@@ -17,6 +17,30 @@ from typing import Any, Dict, List, Optional
 
 from ..datasource import Health, STATUS_DEGRADED, STATUS_DOWN, STATUS_UP
 
+def pin_platform_from_env(env_var: str = "JAX_PLATFORMS") -> None:
+    """Make the JAX_PLATFORMS env var authoritative for model servers.
+
+    Some environments (this one included) ship a sitecustomize that
+    force-registers an accelerator PJRT plugin and overrides
+    jax_platforms at interpreter start — so exporting JAX_PLATFORMS=cpu
+    silently still boots against the accelerator, and when that tunnel is
+    wedged the server hangs forever inside PJRT_Client_Create. Call this
+    BEFORE first device use (backends initialize lazily, so a config
+    re-pin after jax import wins — same mechanism as tests/conftest.py).
+    No-op when the variable is unset."""
+    import os
+
+    value = os.environ.get(env_var)
+    if not value:
+        return
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", value)
+    except Exception:  # noqa: BLE001 - plain jax builds have no override
+        pass
+
+
 TTFT_BUCKETS = (0.01, 0.025, 0.05, 0.1, 0.15, 0.25, 0.5, 1, 2.5, 5, 10)
 TPOT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 1)
 BATCH_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256)
@@ -34,6 +58,9 @@ class TPUClient:
         self._devices: List[Any] = []
         self._connected_at: Optional[float] = None
         self._jax = None
+        # single-flight health probe state (see health_check)
+        self._probe_thread = None
+        self._probe_result = None
 
     # -- provider pattern (mongo.go:142-155) ----------------------------------
     def use_logger(self, logger) -> None:
@@ -180,21 +207,52 @@ class TPUClient:
                                    device=str(s["id"]))
 
     # -- health (feeds /.well-known/health) -----------------------------------
-    def health_check(self) -> Health:
-        if not self._devices:
-            return Health(status=STATUS_DOWN, details={"error": "no devices"})
+    # the device round-trip gets this long before the probe is declared
+    # stuck; a wedged PJRT call can block FOREVER, and /health must answer
+    # regardless (class attr so deployments/tests can tune per instance)
+    HEALTH_PROBE_TIMEOUT_S = 3.0
+
+    def _probe_device(self) -> None:
+        """The actual device round-trip, run on the single-flight probe
+        thread: like the SQL ping (sql/health.go:26-65), but isolated so a
+        device that stops answering (r5: wedged tunnel, PJRT call never
+        returns) pins ONE daemon thread instead of every health handler."""
         try:
             import jax.numpy as jnp
 
-            # tiny device round-trip proves the runtime is actually alive,
-            # like the SQL ping (sql/health.go:26-65)
-            probe = float(jnp.asarray(1.0) + 1.0)
-            ok = probe == 2.0
+            ok = float(jnp.asarray(1.0) + 1.0) == 2.0
+            self._probe_result = (STATUS_UP if ok else STATUS_DEGRADED, None)
         except Exception as exc:  # noqa: BLE001
-            return Health(status=STATUS_DOWN, details={"error": str(exc)})
+            self._probe_result = (STATUS_DOWN, str(exc))
+
+    def health_check(self) -> Health:
+        if not self._devices:
+            return Health(status=STATUS_DOWN, details={"error": "no devices"})
+        import threading
+
+        # single-flight: while one probe is still blocked inside the
+        # device, health polls reuse it (reporting DEGRADED) rather than
+        # piling up a stuck thread per poll
+        probe = self._probe_thread
+        if probe is None or not probe.is_alive():
+            self._probe_result = None
+            probe = threading.Thread(target=self._probe_device,
+                                     name="tpu-health-probe", daemon=True)
+            self._probe_thread = probe
+            probe.start()
+        probe.join(timeout=self.HEALTH_PROBE_TIMEOUT_S)
+        if probe.is_alive():
+            return Health(status=STATUS_DEGRADED, details={
+                "platform": self.platform,
+                "error": f"device probe stuck for "
+                         f">{self.HEALTH_PROBE_TIMEOUT_S:.0f}s "
+                         f"(runtime not answering)",
+            })
+        status, err = self._probe_result
+        if status == STATUS_DOWN:
+            return Health(status=STATUS_DOWN, details={"error": err})
         self.refresh_memory_metrics()
         mem = self.memory_stats()
-        status = STATUS_UP if ok else STATUS_DEGRADED
         return Health(status=status, details={
             "platform": self.platform,
             "devices": len(self._devices),

@@ -69,6 +69,26 @@ class EngineDrainingError(RuntimeError):
     def __init__(self):
         super().__init__("engine draining: not accepting new requests")
 
+
+class EngineStalledError(RuntimeError):
+    """Submitted while the engine loop is stuck inside a device call.
+
+    Observed failure shape (r5, axon tunnel): the device serves normally,
+    then stops answering mid-flight — the loop thread blocks forever inside
+    a PJRT sync that no Python-level timeout can interrupt. Without this
+    shed, every new request queues behind a dispatch that will never
+    complete and its client blocks until its own timeout; with it, the
+    server answers 503 immediately (the reference's breaker-open posture:
+    fail fast toward the load balancer, service/circuit_breaker.go
+    analog) while /health reports the engine DEGRADED with the stall age."""
+
+    status_code = 503
+
+    def __init__(self, stall_s: float):
+        super().__init__(
+            f"engine loop stuck in a device call for {stall_s:.0f}s "
+            f"(device not answering); shedding new requests")
+
 _request_ids = itertools.count(1)
 
 
@@ -251,6 +271,13 @@ class LLMEngine:
     # probes restart the EMA at 2x the floor: ~4-5 consecutive
     # zero-acceptance verifies before re-cooling, one good one to recover
     SPEC_PROBE_EMA = 0.5
+
+    # submit() sheds (503) once the loop has been stuck inside one device
+    # call this long. Must clear any LEGITIMATE in-dispatch pause: the
+    # longest observed healthy quiet stretch is a mid-serve cache-growth
+    # compile (~70 s on the tunneled backend); 150 s is 2x that. Class
+    # attr so deployments and tests can tune it per instance.
+    STALL_REJECT_S = 150.0
 
     def __init__(
         self,
@@ -497,6 +524,11 @@ class LLMEngine:
         #   ("prefill", first_tokens [K] future, [(slot_idx, request)])
         self._inflight: "collections.deque" = collections.deque()
 
+        # wedge detection: the loop stamps this every iteration; a stamp
+        # that stops moving while work is in flight means the thread is
+        # stuck inside a device call (stall_seconds / EngineStalledError)
+        self._last_step_at = time.monotonic()
+
         self._init_device_state()
 
         # rolling throughput window
@@ -635,6 +667,38 @@ class LLMEngine:
                         else self.max_seq_len)
         return min(bucket_limit, self.max_seq_len - 1)
 
+    @property
+    def stall_seconds(self) -> float:
+        """Seconds the loop thread has been stuck inside ONE device call,
+        0.0 when healthy. Host-side only — reading it never touches the
+        device (a probe that did would hang on the exact failure it is
+        meant to detect). An idle engine parks in 50 ms waits, so the stamp
+        only stops moving while a dispatch or sync is actually blocked."""
+        if self._thread is None or not self._thread.is_alive():
+            return 0.0
+        return max(0.0, time.monotonic() - self._last_step_at)
+
+    def wedged(self) -> bool:
+        return self.stall_seconds > self.STALL_REJECT_S
+
+    def health_check(self):
+        """Container health contributor (container.add_health_contributor):
+        DEGRADED once the loop stalls past the shed threshold. DEGRADED,
+        not DOWN — already-dispatched work could still complete if the
+        device recovers, and a load balancer should stop routing here
+        either way."""
+        from ..container import Health, STATUS_DEGRADED, STATUS_UP
+
+        stall = self.stall_seconds
+        details = {
+            "active_slots": sum(1 for s in self.slots if s.active),
+            "queue_depth": self._pending.qsize(),
+        }
+        if self.wedged():
+            details["stall_seconds"] = round(stall, 1)
+            return Health(status=STATUS_DEGRADED, details=details)
+        return Health(status=STATUS_UP, details=details)
+
     def submit(self, prompt_tokens: Sequence[int], max_new_tokens: int = 128,
                temperature: float = 0.0,
                stop_tokens: Optional[Set[int]] = None,
@@ -650,6 +714,9 @@ class LLMEngine:
             raise RuntimeError("engine is stopped")
         if self._draining:
             raise EngineDrainingError()
+        stall = self.stall_seconds
+        if stall > self.STALL_REJECT_S:
+            raise EngineStalledError(stall)
         if self._plane is not None and not self._plane.is_leader:
             # multi-controller serving has ONE ingress: rank 0 composes
             # every admission wave; this rank only replays them
@@ -1402,6 +1469,7 @@ class LLMEngine:
     # -- engine loop ----------------------------------------------------------
     def _loop(self) -> None:
         while not self._stop.is_set():
+            self._last_step_at = time.monotonic()
             try:
                 with self._state_lock:
                     self._admit()
