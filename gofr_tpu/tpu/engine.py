@@ -679,6 +679,14 @@ class LLMEngine:
         return max(0.0, time.monotonic() - self._last_step_at)
 
     def wedged(self) -> bool:
+        if self._plane is not None:
+            # multi-controller loops legitimately block inside collectives
+            # waiting for peer ranks (startup skew, wave sync) for
+            # arbitrarily long; host-side stall age cannot distinguish
+            # that from a dead device, so the shed is single-controller
+            # only — a genuinely dead device still surfaces through the
+            # per-token timeouts of the requests themselves
+            return False
         return self.stall_seconds > self.STALL_REJECT_S
 
     def health_check(self):
@@ -714,9 +722,8 @@ class LLMEngine:
             raise RuntimeError("engine is stopped")
         if self._draining:
             raise EngineDrainingError()
-        stall = self.stall_seconds
-        if stall > self.STALL_REJECT_S:
-            raise EngineStalledError(stall)
+        if self.wedged():
+            raise EngineStalledError(self.stall_seconds)
         if self._plane is not None and not self._plane.is_leader:
             # multi-controller serving has ONE ingress: rank 0 composes
             # every admission wave; this rank only replays them
