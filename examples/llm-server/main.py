@@ -200,6 +200,12 @@ def build_engine(app: App, default_sampling_controls: bool = False) -> LLMEngine
         engine.warmup(k_variants=warm_mode == "wide")
         app.logger.infof("engine warmed up in %.1fs%s", time.time() - t0,
                          " (wide)" if warm_mode == "wide" else "")
+    # /.well-known/health reports the engine next to the datasources: a
+    # wedged device (loop stuck in a PJRT call) degrades the aggregate so
+    # load balancers stop routing here, matching submit()'s 503 shed.
+    # Registered here so every server built on this engine (llm-server,
+    # openai-server) gets it, not just the /generate surface.
+    app.container.add_health_contributor("engine", engine.health_check)
     return engine
 
 
@@ -271,9 +277,8 @@ def build_app(config=None, engine=None) -> App:
     elif getattr(engine, "tokenizer", None) is None:
         engine.tokenizer = ByteTokenizer()
     app.engine = engine
-    # /.well-known/health reports the engine next to the datasources: a
-    # wedged device (loop stuck in a PJRT call) degrades the aggregate so
-    # load balancers stop routing here, matching submit()'s 503 shed
+    # idempotent when build_engine already registered it (dict keyed by
+    # name); covers the injected-engine path (tests) too
     app.container.add_health_contributor("engine", engine.health_check)
     tokenizer: ByteTokenizer = engine.tokenizer
     # token streaming over gRPC rides the same engine (GRPC_PORT)

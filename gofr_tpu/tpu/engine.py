@@ -722,8 +722,12 @@ class LLMEngine:
             raise RuntimeError("engine is stopped")
         if self._draining:
             raise EngineDrainingError()
-        if self.wedged():
-            raise EngineStalledError(self.stall_seconds)
+        # capture once: the loop could stamp a fresh heartbeat between a
+        # wedged() check and the error construction, and the 503's stall
+        # age must match the measurement that triggered the shed
+        stall = self.stall_seconds
+        if self._plane is None and stall > self.STALL_REJECT_S:
+            raise EngineStalledError(stall)
         if self._plane is not None and not self._plane.is_leader:
             # multi-controller serving has ONE ingress: rank 0 composes
             # every admission wave; this rank only replays them
