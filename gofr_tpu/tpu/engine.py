@@ -678,16 +678,24 @@ class LLMEngine:
             return 0.0
         return max(0.0, time.monotonic() - self._last_step_at)
 
-    def wedged(self) -> bool:
+    def _stall_over_threshold(self) -> float:
+        """THE shed policy, read once: 0.0 when healthy or exempt,
+        otherwise the captured stall age (so every consumer — the 503, the
+        health report — carries the same measurement that tripped it).
+
+        Multi-controller exemption: loops with an admission plane
+        legitimately block inside collectives waiting for peer ranks
+        (startup skew, wave sync) for arbitrarily long; host-side stall
+        age cannot distinguish that from a dead device, so the shed is
+        single-controller only — a genuinely dead device still surfaces
+        through the requests' own per-token timeouts."""
         if self._plane is not None:
-            # multi-controller loops legitimately block inside collectives
-            # waiting for peer ranks (startup skew, wave sync) for
-            # arbitrarily long; host-side stall age cannot distinguish
-            # that from a dead device, so the shed is single-controller
-            # only — a genuinely dead device still surfaces through the
-            # per-token timeouts of the requests themselves
-            return False
-        return self.stall_seconds > self.STALL_REJECT_S
+            return 0.0
+        stall = self.stall_seconds
+        return stall if stall > self.STALL_REJECT_S else 0.0
+
+    def wedged(self) -> bool:
+        return self._stall_over_threshold() > 0.0
 
     def health_check(self):
         """Container health contributor (container.add_health_contributor):
@@ -697,12 +705,12 @@ class LLMEngine:
         either way."""
         from ..container import Health, STATUS_DEGRADED, STATUS_UP
 
-        stall = self.stall_seconds
         details = {
             "active_slots": sum(1 for s in self.slots if s.active),
             "queue_depth": self._pending.qsize(),
         }
-        if self.wedged():
+        stall = self._stall_over_threshold()
+        if stall:
             details["stall_seconds"] = round(stall, 1)
             return Health(status=STATUS_DEGRADED, details=details)
         return Health(status=STATUS_UP, details=details)
@@ -722,11 +730,8 @@ class LLMEngine:
             raise RuntimeError("engine is stopped")
         if self._draining:
             raise EngineDrainingError()
-        # capture once: the loop could stamp a fresh heartbeat between a
-        # wedged() check and the error construction, and the 503's stall
-        # age must match the measurement that triggered the shed
-        stall = self.stall_seconds
-        if self._plane is None and stall > self.STALL_REJECT_S:
+        stall = self._stall_over_threshold()
+        if stall:
             raise EngineStalledError(stall)
         if self._plane is not None and not self._plane.is_leader:
             # multi-controller serving has ONE ingress: rank 0 composes

@@ -258,3 +258,92 @@ def test_prefix_cache_engine_concurrent_submit_cancel():
         prompts={i: base + [30 + i] for i in range(6)},
         max_new=6, n_threads=10, rounds=4, cancel_mod=3,
         cls=PagedLLMEngine, on_done=assert_no_leaks)
+
+
+def test_wedge_recovery_races_concurrent_submitters():
+    """Submitters racing wedge onset and recovery: every request must end
+    terminal (tokens, EngineStalledError shed, or a cancel) — no client
+    stranded, no deadlock, and the engine serves normally afterwards.
+
+    The wedge is the r5 tunnel failure shape: the loop blocks inside one
+    device sync. Simulated by gating _sync_oldest; threads submit across
+    the healthy->wedged->recovered transitions."""
+    import time
+
+    from gofr_tpu.models.llama import LlamaConfig, llama_init
+    from gofr_tpu.tpu.engine import EngineStalledError, LLMEngine
+
+    cfg = LlamaConfig.debug()
+    eng = LLMEngine(llama_init(cfg, seed=0), cfg, n_slots=4, max_seq_len=64,
+                    prefill_buckets=(8,), decode_block_size=4)
+    eng.STALL_REJECT_S = 0.2
+    eng.start()
+    # warm so the wedge window isn't spent compiling
+    eng.generate([1, 2, 3], max_new_tokens=4)
+
+    gate = threading.Event()
+    gate.set()  # healthy to start
+    orig_sync = eng._sync_oldest
+
+    def gated_sync():
+        gate.wait(timeout=30)
+        return orig_sync()
+
+    eng._sync_oldest = gated_sync
+    outcomes = {"ok": 0, "shed": 0, "timeout": 0}
+    tally = threading.Lock()
+    done = threading.Event()
+
+    def submitter(i):
+        r = 0
+        # keep traffic flowing until the toggler has PROVEN both wedge
+        # cycles engaged — fixed-round submitters can finish before the
+        # first gate.clear() on a fast machine, passing vacuously. The
+        # result timeout is SHORT on purpose: a wedged wave strands its
+        # waiters, and a stranded client's timeout->cancel->resubmit is
+        # exactly the retry that must then hit the shed.
+        while not done.is_set():
+            r += 1
+            try:
+                req = eng.submit([1 + (i + r) % 5, 2, 3], max_new_tokens=4)
+                tokens = req.result(timeout_s=3.0)
+                with tally:
+                    outcomes["ok"] += 1
+                assert len(tokens) == 4
+            except EngineStalledError:
+                with tally:
+                    outcomes["shed"] += 1
+                time.sleep(0.05)
+            except TimeoutError:
+                # result() already cancelled the request (stream() contract)
+                with tally:
+                    outcomes["timeout"] += 1
+
+    def toggler(_):
+        try:
+            for _ in range(2):
+                time.sleep(0.15)
+                gate.clear()  # wedge: next sync blocks
+                # deterministic engagement: wait until the stall actually
+                # passed the shed threshold AND a submitter was shed
+                deadline = time.time() + 20
+                while time.time() < deadline:
+                    with tally:
+                        shed = outcomes["shed"]
+                    if eng.stall_seconds > eng.STALL_REJECT_S and shed:
+                        break
+                    time.sleep(0.02)
+                assert eng.stall_seconds > eng.STALL_REJECT_S, "never wedged"
+                gate.set()  # device answers again
+        finally:
+            done.set()
+
+    _hammer(9, lambda i: toggler(i) if i == 0 else submitter(i))
+
+    eng._sync_oldest = orig_sync
+    assert outcomes["ok"] > 0, outcomes
+    assert outcomes["shed"] > 0, outcomes
+    # after recovery the engine serves normally and health is clean
+    assert len(eng.generate([9, 8, 7], max_new_tokens=5)) == 5
+    assert eng.health_check().status == "UP"
+    eng.stop()
