@@ -126,6 +126,31 @@ def test_greedy_continuation_matches_transformers(tmp_path):
     assert ours == ref.tolist()
 
 
+def test_int8_quantize_on_load_matches_post_hoc_quantize(tmp_path):
+    """WEIGHT_DTYPE=int8 on a real HF-written checkpoint must equal
+    loading float then quantizing: the streaming per-leaf quantize path
+    and quantize_weights share per-output-channel semantics bit-exactly."""
+    import jax
+
+    from gofr_tpu.models.llama import quantize_weights
+
+    model = _hf_model(False)
+    ckpt = tmp_path / "ckpt"
+    model.save_pretrained(ckpt, safe_serialization=True)
+    cfg = _our_cfg()
+
+    via_load = load_llama_safetensors(cfg, str(ckpt), weight_dtype="int8")
+    via_post = quantize_weights(load_llama_safetensors(cfg, str(ckpt)))
+
+    flat_a = jax.tree_util.tree_leaves_with_path(via_load)
+    flat_b = dict(jax.tree_util.tree_leaves_with_path(via_post))
+    assert len(flat_a) == len(flat_b)
+    for path, leaf in flat_a:
+        np.testing.assert_array_equal(
+            np.asarray(leaf), np.asarray(flat_b[path]),
+            err_msg=jax.tree_util.keystr(path))
+
+
 def test_loader_tolerates_hf_config_artifacts(tmp_path):
     """save_pretrained writes config.json/generation_config.json next to the
     weights; directory-form loading must key off the safetensors files only."""

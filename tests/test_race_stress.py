@@ -321,19 +321,28 @@ def test_wedge_recovery_races_concurrent_submitters():
 
     def toggler(_):
         try:
-            for _ in range(2):
+            for cycle in range(2):
+                with tally:
+                    shed_before = outcomes["shed"]
                 time.sleep(0.15)
                 gate.clear()  # wedge: next sync blocks
-                # deterministic engagement: wait until the stall actually
-                # passed the shed threshold AND a submitter was shed
+                # deterministic engagement PER CYCLE: wait until the stall
+                # passed the shed threshold AND a submitter was shed in
+                # THIS cycle (a cumulative check would make cycle 2
+                # vacuous, never proving recovery-then-re-wedge sheds)
                 deadline = time.time() + 20
                 while time.time() < deadline:
                     with tally:
                         shed = outcomes["shed"]
-                    if eng.stall_seconds > eng.STALL_REJECT_S and shed:
+                    if (eng.stall_seconds > eng.STALL_REJECT_S
+                            and shed > shed_before):
                         break
                     time.sleep(0.02)
-                assert eng.stall_seconds > eng.STALL_REJECT_S, "never wedged"
+                assert eng.stall_seconds > eng.STALL_REJECT_S, (
+                    f"cycle {cycle}: never wedged")
+                with tally:
+                    assert outcomes["shed"] > shed_before, (
+                        f"cycle {cycle}: no submitter shed")
                 gate.set()  # device answers again
         finally:
             done.set()

@@ -7,7 +7,8 @@ weak #4: "soak results are claims, not artifacts"):
     python tools/soak.py mixed       # dense engine, chunked prefill
     python tools/soak.py paged-int8  # paged pool, int8 pages + weights
     python tools/soak.py spec        # speculative decoding (paged pool)
-    python tools/soak.py all         # the three in sequence
+    python tools/soak.py multihost   # two-process live-traffic admission
+    python tools/soak.py all         # the four in sequence
     python tools/soak.py all --seconds 180 --threads 6
 
 Each profile boots an engine, runs N seconds of Poisson-arrival traffic
@@ -166,10 +167,66 @@ def run_profile(profile: str, seconds: float, n_threads: int,
     return ok
 
 
+def run_multihost(seconds: float) -> bool:
+    """Two-process live-traffic soak over the admission plane: Poisson
+    arrivals + random cancels at rank 0 while the tp=2 engine loop runs,
+    rank 1 mirroring from the wave stream alone. Pass = both ranks exit 0,
+    rank 0 matched its single-device oracle (asserted in-worker), and the
+    two ranks' served streams checksum identically. CPU-only by design
+    (two processes cannot share the single-tenant TPU tunnel)."""
+    import socket
+    import subprocess
+
+    worker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "multihost_soak_worker.py")
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    t0 = time.time()
+    procs = [subprocess.Popen(
+        [sys.executable, worker, str(rank), str(port), str(seconds), "11"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env)
+        for rank in (0, 1)]
+    outs = []
+    stats = {"profile": "multihost"}
+    try:
+        for rank, p in enumerate(procs):
+            out, err = p.communicate(timeout=seconds + 600)
+            outs.append((p.returncode, out, err))
+    except subprocess.TimeoutExpired:
+        # a hung worker must still produce the pass/fail artifact — the
+        # soak's whole contract is "results are artifacts, not claims"
+        stats[f"rank{len(outs)}_error"] = f"worker hung past {seconds + 600:.0f}s"
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    stats["seconds"] = round(time.time() - t0, 1)
+    ok = len(outs) == 2
+    checksums = []
+    for rank, (rc, out, err) in enumerate(outs):
+        if rc != 0 or f"RANK{rank}_SOAK_OK" not in out:
+            ok = False
+            stats[f"rank{rank}_error"] = (err or out)[-400:]
+            continue
+        line = [l for l in out.splitlines() if "checksum=" in l][0]
+        checksums.append(line.split("checksum=")[1].split(" ")[0])
+        stats[f"rank{rank}"] = json.loads(line.split("stats=")[1])
+    match = len(checksums) == 2 and checksums[0] == checksums[1]
+    ok = ok and match
+    stats["checksums_match"] = match
+    stats["pass"] = ok
+    print(json.dumps(stats))
+    return ok
+
+
 def main() -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("profile", nargs="?", default="all",
-                        choices=["mixed", "paged-int8", "spec", "all"])
+                        choices=["mixed", "paged-int8", "spec", "multihost",
+                                 "all"])
     parser.add_argument("--seconds", type=float, default=120.0)
     parser.add_argument("--threads", type=int, default=4)
     args = parser.parse_args()
@@ -181,11 +238,20 @@ def main() -> int:
         jax.config.update("jax_platforms", platform)
     preset = os.environ.get("SOAK_PRESET", "debug")
 
-    profiles = (["mixed", "paged-int8", "spec"] if args.profile == "all"
-                else [args.profile])
-    ok = all([run_profile(p, args.seconds, args.threads, preset)
-              for p in profiles])
-    return 0 if ok else 1
+    profiles = (["mixed", "paged-int8", "spec", "multihost"]
+                if args.profile == "all" else [args.profile])
+    results = []
+    for p in profiles:
+        if p == "multihost":
+            # under `all`, cap the two-process tier so it doesn't dominate
+            # the sequence's wall time (the plane's invariants saturate
+            # within ~30 s); an explicit `multihost` run honors --seconds
+            seconds = (min(args.seconds, 30.0) if args.profile == "all"
+                       else args.seconds)
+            results.append(run_multihost(seconds))
+        else:
+            results.append(run_profile(p, args.seconds, args.threads, preset))
+    return 0 if all(results) else 1
 
 
 if __name__ == "__main__":
