@@ -135,7 +135,10 @@ def main() -> None:
         "JAX_COORDINATOR_ADDR": f"127.0.0.1:{port}",
         "JAX_NUM_PROCESSES": "2",
         "JAX_PROCESS_ID": str(rank),
-        "JAX_COORDINATOR_TIMEOUT_S": "60",
+        # generous: under a fully-loaded CI box (the whole suite runs in
+        # parallel with 8-device compiles) rank startup skew alone has
+        # blown a 60s rendezvous
+        "JAX_COORDINATOR_TIMEOUT_S": "150",
     }))
     assert spec is not None and spec.process_id == rank
     assert jax.process_count() == 2

@@ -38,7 +38,7 @@ def main() -> None:
         "JAX_COORDINATOR_ADDR": f"127.0.0.1:{port}",
         "JAX_NUM_PROCESSES": "2",
         "JAX_PROCESS_ID": str(rank),
-        "JAX_COORDINATOR_TIMEOUT_S": "60",
+        "JAX_COORDINATOR_TIMEOUT_S": "150",
     }))
     assert spec is not None and spec.process_id == rank
     assert jax.process_count() == 2, jax.process_count()
