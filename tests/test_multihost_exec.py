@@ -32,7 +32,7 @@ def test_two_process_mesh_executes_cross_host_reduction():
     outs = []
     try:
         for p in procs:
-            out, err = p.communicate(timeout=150)
+            out, err = p.communicate(timeout=420)
             outs.append((p.returncode, out, err))
     finally:
         for p in procs:
