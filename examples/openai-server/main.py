@@ -81,10 +81,6 @@ def build_app(**kw) -> App:
         ("presence_penalty", lambda v: float(v) != 0.0),
         ("frequency_penalty", lambda v: float(v) != 0.0),
         ("logit_bias", lambda v: bool(v)),
-        # logprobs=0 still requests the chosen token's logprob (the OpenAI
-        # default is null/absent, not 0) — only absence is a no-op
-        ("logprobs", lambda v: v is not None),
-        ("top_logprobs", lambda v: bool(v)),
         ("best_of", lambda v: int(v) > 1),
         ("suffix", lambda v: bool(v)),
     )
@@ -152,12 +148,6 @@ def build_app(**kw) -> App:
                              stop_tokens={tokenizer.EOS},
                              min_tokens=min_tokens, top_p=top_p, top_k=top_k)
 
-    def _submit(prompt: str, max_tokens: int, temperature: float,
-                min_tokens: int = 0, top_p: float = 0.0, top_k: int = 0):
-        prompt_tokens = _encode_checked(prompt)
-        return _submit_tokens(prompt_tokens, max_tokens, temperature,
-                              min_tokens, top_p, top_k), prompt_tokens
-
     def _finish_reason(n_emitted: int, max_tokens: int) -> str:
         return "length" if n_emitted >= max_tokens else "stop"
 
@@ -179,13 +169,136 @@ def build_app(**kw) -> App:
             return 0
         return len(tokenizer.decode(tokens[:min_tokens]))
 
+    def _parse_logprobs(body: dict, chat: bool):
+        """OpenAI logprobs semantics, split by surface. Returns None (off)
+        or the number of top alternatives to attach (0 = chosen only).
+
+        completions: `logprobs: 0..5` (int). chat: `logprobs: true` +
+        `top_logprobs: 0..20`. Served by the teacher-forced scoring pass
+        (engine.score) after generation completes — exact decode-time
+        distributions, zero hot-path cost when unused."""
+        if chat:
+            flag = body.get("logprobs")
+            if flag in (None, False):
+                if body.get("top_logprobs"):
+                    raise InvalidParam(["top_logprobs requires logprobs=true"])
+                return None
+            if flag is not True:
+                raise InvalidParam(["logprobs"])
+            try:
+                n = int(body.get("top_logprobs", 0) or 0)
+            except (TypeError, ValueError) as exc:
+                raise InvalidParam(["top_logprobs"]) from exc
+            if not 0 <= n <= 20:
+                raise InvalidParam(["top_logprobs must be 0..20"])
+            return n
+        if body.get("top_logprobs"):
+            raise InvalidParam(["top_logprobs is a chat parameter; "
+                                "completions take logprobs=0..5"])
+        v = body.get("logprobs")
+        if v is None:
+            return None
+        if isinstance(v, bool):
+            # chat-style true/false on the completions surface: OpenAI
+            # 400s the non-integer rather than coercing 0/1
+            raise InvalidParam(["logprobs must be an integer 0..5"])
+        try:
+            n = int(v)
+        except (TypeError, ValueError) as exc:
+            raise InvalidParam(["logprobs"]) from exc
+        if not 0 <= n <= 5:
+            raise InvalidParam(["logprobs must be 0..5"])
+        return n
+
+    def _check_scoreable(prompt_len: int, max_tokens: int) -> None:
+        """Reject un-scoreable logprobs requests AT ADMISSION: generation
+        can run past the largest scoring bucket (admission caps the prompt,
+        not prompt+completion), and discovering that after paying for the
+        whole generation would be a 500 instead of this 400."""
+        cap = engine.prefill_buckets[-1]
+        if prompt_len + max_tokens > cap:
+            raise InvalidParam(
+                [f"logprobs supports prompt+max_tokens up to {cap} "
+                 f"tokens on this server"])
+
+    def _token_bytes(token_id: int) -> bytes:
+        tb = getattr(tokenizer, "decode_token_bytes", None)
+        if tb is not None:
+            return tb(token_id)
+        return tokenizer.decode_token(token_id).encode("utf-8", "ignore")
+
+    def _tokens_for_text(tokens, text: str):
+        """The largest token prefix whose decoded concatenation fits the
+        (possibly stop-string-truncated) returned text — logprobs must
+        describe the text the client actually received, not generation the
+        stop rule cut away."""
+        out, acc = [], 0
+        for t in tokens:
+            piece = tokenizer.decode_token(int(t))
+            if acc + len(piece) > len(text):
+                break
+            acc += len(piece)
+            out.append(t)
+        return out
+
+    def _logprobs_payload(chat: bool, prompt_toks, tokens, n_top: int,
+                          text=None):
+        """Format engine.score output in the surface's shape. `text`
+        (when given) clips the scored tokens to the returned text."""
+        if text is not None:
+            tokens = _tokens_for_text(tokens, text)
+        if not tokens:
+            return {"content": []} if chat else {
+                "tokens": [], "token_logprobs": [], "top_logprobs": None,
+                "text_offset": []}
+        chosen, top_ids, top_lps = engine.score(prompt_toks, tokens,
+                                                top=max(n_top, 1))
+        if chat:
+            content = []
+            for t, c, irow, lrow in zip(tokens, chosen, top_ids, top_lps):
+                entry = {"token": tokenizer.decode_token(int(t)),
+                         "logprob": round(float(c), 6),
+                         "bytes": list(_token_bytes(int(t)))}
+                if n_top:
+                    entry["top_logprobs"] = [
+                        {"token": tokenizer.decode_token(int(i)),
+                         "logprob": round(float(l), 6),
+                         "bytes": list(_token_bytes(int(i)))}
+                        for i, l in zip(irow[:n_top], lrow[:n_top])]
+                content.append(entry)
+            return {"content": content}
+        token_strs = [tokenizer.decode_token(int(t)) for t in tokens]
+        offsets, off = [], 0
+        for s in token_strs:
+            offsets.append(off)
+            off += len(s)
+        top = None
+        if n_top:
+            # keyed by decoded string (the OpenAI completions shape): with
+            # a byte-level vocab two alternative ids can decode to the same
+            # string — keep the best-probability one (ids arrive sorted
+            # descending, so first insert wins)
+            top = []
+            for irow, lrow in zip(top_ids, top_lps):
+                d = {}
+                for i, l in zip(irow[:n_top], lrow[:n_top]):
+                    d.setdefault(tokenizer.decode_token(int(i)),
+                                 round(float(l), 6))
+                top.append(d)
+        return {"tokens": token_strs,
+                "token_logprobs": [round(float(c), 6) for c in chosen],
+                "top_logprobs": top, "text_offset": offsets}
+
     def _multi_completion(ctx, chat, prompt, n_choices, max_tokens,
-                          temperature, stop_strs, min_tokens, top_p, top_k):
+                          temperature, stop_strs, min_tokens, top_p, top_k,
+                          lp_n=None):
         """n > 1: fan the prompt out as n engine requests (they batch into
         the same continuous-batching slots) and collect n choices. Encode
         once; ANY failure cancels every sibling so abandoned requests
         can't keep occupying decode slots."""
         prompt_toks = _encode_checked(prompt)
+        if lp_n is not None:
+            _check_scoreable(len(prompt_toks), max_tokens)
         requests = []
         choices, total_out = [], 0
         try:
@@ -205,8 +318,11 @@ def build_app(**kw) -> App:
                                             _floor_chars(tokens, min_tokens))
                 body = ({"message": {"role": "assistant", "content": text}}
                         if chat else {"text": text})
+                lp = (_logprobs_payload(chat, prompt_toks, tokens, lp_n,
+                                        text=text)
+                      if lp_n is not None else None)
                 choices.append(dict(index=idx, finish_reason=finish,
-                                    logprobs=None, **body))
+                                    logprobs=lp, **body))
         except BaseException:
             for req in requests:
                 req.cancel()
@@ -244,6 +360,12 @@ def build_app(**kw) -> App:
                 raise InvalidParam(["prompt"])
         (max_tokens, temperature, stop_strs, min_tokens, top_p,
          top_k) = _params(body)
+        lp_n = _parse_logprobs(body, chat)
+        if lp_n is not None and body.get("stream"):
+            # scoring runs AFTER generation; attaching it to a stream would
+            # mean holding every chunk back — reject honestly instead
+            raise InvalidParam(["logprobs are not supported with "
+                               "stream=true on this server"])
         try:
             n_choices = int(body.get("n", 1))
         except (TypeError, ValueError) as exc:
@@ -259,9 +381,12 @@ def build_app(**kw) -> App:
                 raise InvalidParam(["n > 1 requires temperature > 0"])
             return _multi_completion(ctx, chat, prompt, n_choices,
                                      max_tokens, temperature, stop_strs,
-                                     min_tokens, top_p, top_k)
-        request, prompt_toks = _submit(prompt, max_tokens, temperature,
-                                       min_tokens, top_p, top_k)
+                                     min_tokens, top_p, top_k, lp_n=lp_n)
+        prompt_toks = _encode_checked(prompt)
+        if lp_n is not None:
+            _check_scoreable(len(prompt_toks), max_tokens)
+        request = _submit_tokens(prompt_toks, max_tokens, temperature,
+                                 min_tokens, top_p, top_k)
         created = int(time.time())
         rid = (f"chatcmpl-{uuid.uuid4().hex[:24]}" if chat
                else f"cmpl-{uuid.uuid4().hex[:24]}")
@@ -354,10 +479,13 @@ def build_app(**kw) -> App:
                                     _floor_chars(tokens, min_tokens))
         message_or_text = ({"message": {"role": "assistant", "content": text}}
                            if chat else {"text": text})
+        lp = (_logprobs_payload(chat, prompt_toks, tokens, lp_n,
+                                text=text)
+              if lp_n is not None else None)
         return Raw({
             "id": rid, "object": obj, "created": created, "model": model_id,
             "choices": [dict(index=0, finish_reason=finish,
-                             logprobs=None, **message_or_text)],
+                             logprobs=lp, **message_or_text)],
             "usage": {"prompt_tokens": len(prompt_toks),
                       "completion_tokens": len(tokens),
                       "total_tokens": len(prompt_toks) + len(tokens)},

@@ -775,6 +775,17 @@ class LLMEngine:
     def generate(self, prompt_tokens: Sequence[int], **kw) -> List[int]:
         return self.submit(prompt_tokens, **kw).result()
 
+    def score(self, prompt_tokens: Sequence[int],
+              completion_tokens: Sequence[int], top: int = 5):
+        """Teacher-forced per-token logprobs for a completion (the OpenAI
+        `logprobs` feature): returns (chosen_lp [C], top_ids [C, top],
+        top_lps [C, top]) numpy arrays. Additive post-hoc pass — see
+        tpu/score.py for why this reproduces decode-time distributions
+        exactly without touching the serving hot path."""
+        from .score import score_tokens
+
+        return score_tokens(self, prompt_tokens, completion_tokens, top=top)
+
     def start(self) -> None:
         if self._thread is not None:
             return

@@ -507,9 +507,10 @@ def test_openai_server_sampling_params_honored_or_rejected():
                                  "temperature": 1.0, "top_k": 1})
         assert status == 201
         assert trunc_k["choices"][0]["text"] == greedy["choices"][0]["text"]
-        # non-default unsupported params: honest 400s
+        # non-default unsupported params: honest 400s (logprobs 0..5 is
+        # SERVED since r5 via the scoring pass; out-of-range stays 400)
         for body in ({"frequency_penalty": 0.5}, {"presence_penalty": -1},
-                     {"logprobs": 5}, {"logit_bias": {"50": 10}},
+                     {"logprobs": 9}, {"logit_bias": {"50": 10}},
                      {"best_of": 3}, {"top_p": 0.0}, {"top_p": 1.7}):
             status, _ = _call(port, "/v1/completions", "POST",
                               {"prompt": "x", "max_tokens": 2, **body})
