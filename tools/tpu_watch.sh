@@ -24,7 +24,17 @@ assert d[0].platform != 'cpu', d
 " >/dev/null 2>&1; then
     echo "$(date +%F\ %T) probe $i: tunnel ALIVE — running bench (budget ${BUDGET}s)"
     BENCH_BUDGET_S="$BUDGET" python bench.py >"$LOG" 2>&1
-    echo "$(date +%F\ %T) bench rc=$? (log: $LOG)"
+    rc=$?
+    echo "$(date +%F\ %T) bench rc=$rc (log: $LOG)"
+    if [ "$rc" -eq 0 ] && grep -q '"platform": "tpu"' "$LOG"; then
+      # the VERDICT's "done" for the TPU record includes one on-chip soak
+      # profile; capture it while the tunnel is known-alive
+      echo "$(date +%F\ %T) running TPU soak (mixed, llama1b)"
+      SOAK_PLATFORM=tpu SOAK_PRESET=llama1b timeout 1200 \
+        python tools/soak.py mixed --seconds 120 --threads 4 \
+        >SOAK_r05_tpu.json 2>soak_tpu_stderr.log
+      echo "$(date +%F\ %T) soak rc=$? (SOAK_r05_tpu.json)"
+    fi
     exit 0
   fi
   echo "$(date +%F\ %T) probe $i: tunnel still wedged"
