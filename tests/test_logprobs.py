@@ -255,3 +255,30 @@ def test_openai_surface_serves_logprobs():
         assert status == 400
     finally:
         app.shutdown()
+
+
+def test_score_under_tensor_parallel_mesh():
+    """Scoring on a TP engine: sharded params x replicated scoring cache —
+    XLA inserts the collectives; values must match the single-device
+    engine's bit-for-bit semantics (same rtol as TP serving parity)."""
+    import jax
+
+    from gofr_tpu.parallel import MeshPlan, make_mesh
+
+    mesh = make_mesh(MeshPlan(tp=2), devices=jax.devices()[:2])
+    params = llama_init(CFG, seed=0)
+    eng_tp = LLMEngine(params, CFG, n_slots=2, max_seq_len=64,
+                       prefill_buckets=(16, 64), mesh=mesh)
+    eng_tp.start()
+    eng_1 = LLMEngine(params, CFG, n_slots=2, max_seq_len=64,
+                      prefill_buckets=(16, 64))
+    eng_1.start()
+    try:
+        prompt, completion = [3, 1, 4, 1], [5, 9, 2, 6, 5]
+        chosen_tp, ids_tp, lps_tp = eng_tp.score(prompt, completion, top=3)
+        chosen_1, ids_1, _ = eng_1.score(prompt, completion, top=3)
+        np.testing.assert_allclose(chosen_tp, chosen_1, rtol=1e-4, atol=1e-5)
+        np.testing.assert_array_equal(ids_tp, ids_1)
+    finally:
+        eng_tp.stop()
+        eng_1.stop()
