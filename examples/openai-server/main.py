@@ -499,6 +499,62 @@ def build_app(**kw) -> App:
     def chat_completions(ctx):
         return _completion(ctx, chat=True)
 
+    @app.post("/v1/embeddings")
+    def embeddings(ctx):
+        """OpenAI embeddings shape over the served model: the sequence
+        embedding is the last position's final-norm hidden state
+        (engine.embed — the causal summary, E5-Mistral-style pooling),
+        L2-normalized per the OpenAI convention. `input` is a string or a
+        list of strings; encoding_format float (default) or base64
+        (little-endian float32, the OpenAI wire format)."""
+        body = ctx.bind()
+        if not isinstance(body, dict):
+            raise InvalidParam(["body"])
+        raw = body.get("input")
+        inputs = [raw] if isinstance(raw, str) else raw
+        if (not isinstance(inputs, list) or not inputs
+                or not all(isinstance(s, str) and s for s in inputs)):
+            raise InvalidParam(["input must be a non-empty string or list "
+                               "of non-empty strings"])
+        if len(inputs) > 256:
+            # one forward per item runs on this handler: bound the batch
+            # (OpenAI's own cap is 2048 items; this server sizes the bound
+            # to its single-chip, request-timeout reality)
+            raise InvalidParam(["input supports up to 256 items per "
+                               "request on this server"])
+        fmt = body.get("encoding_format", "float")
+        if fmt not in ("float", "base64"):
+            raise InvalidParam(["encoding_format must be float or base64"])
+        cap = engine.prefill_buckets[-1]
+        # validate EVERY item before paying for any forward pass — a late
+        # over-cap item must 400 before the device ran the earlier ones
+        token_lists = []
+        for idx, text in enumerate(inputs):
+            toks = tokenizer.encode(text)
+            if len(toks) > cap:
+                raise InvalidParam(
+                    [f"input[{idx}]: {len(toks)} tokens exceeds the "
+                     f"embedding limit ({cap})"])
+            token_lists.append(toks)
+        data, total_tokens = [], 0
+        for idx, toks in enumerate(token_lists):
+            total_tokens += len(toks)
+            vec = engine.embed(toks)
+            if fmt == "base64":
+                import base64 as _b64
+
+                emb = _b64.b64encode(
+                    vec.astype("<f4").tobytes()).decode("ascii")
+            else:
+                # full float32 precision, same as the base64 wire format —
+                # the two encodings must return the same vector
+                emb = [float(x) for x in vec]
+            data.append({"object": "embedding", "index": idx,
+                         "embedding": emb})
+        return Raw({"object": "list", "data": data, "model": model_id,
+                    "usage": {"prompt_tokens": total_tokens,
+                              "total_tokens": total_tokens}})
+
     return app
 
 

@@ -786,6 +786,14 @@ class LLMEngine:
 
         return score_tokens(self, prompt_tokens, completion_tokens, top=top)
 
+    def embed(self, tokens: Sequence[int], normalize: bool = True):
+        """Last-position final-norm hidden state as a sequence embedding
+        (float32 [D], L2-normalized by default) — backs /v1/embeddings.
+        Additive post-hoc pass like score(); see tpu/score.py."""
+        from .score import embed_tokens
+
+        return embed_tokens(self, tokens, normalize=normalize)
+
     def start(self) -> None:
         if self._thread is not None:
             return

@@ -1,25 +1,27 @@
-"""Teacher-forced per-token logprobs: the OpenAI `logprobs` feature.
+"""Post-hoc model passes: per-token logprobs (OpenAI `logprobs`) and
+sequence embeddings (`/v1/embeddings`).
 
-Design: a POST-HOC scoring pass instead of logprob plumbing through the
-serving hot path. For this engine's decoding (greedy / temperature /
-top-k/p are all draws from the position's distribution), the distribution
-at completion position i conditions only on the tokens before it — so a
-teacher-forced forward over prompt+completion reproduces the decode-time
-distributions exactly, and one additive program family delivers
-chosen-token logprobs + top-K alternatives with ZERO changes to the
-prefill/decode/speculative programs or their signatures. The cost model
-matches how the feature is used: nothing on the default path, one
-bucketed forward per request that asks.
+Design: additive passes instead of plumbing through the serving hot path.
+For this engine's decoding (greedy / temperature / top-k/p are all draws
+from the position's distribution), the distribution at completion position
+i conditions only on the tokens before it — so a teacher-forced forward
+over prompt+completion reproduces the decode-time distributions exactly,
+and one additive program family delivers chosen-token logprobs + top-K
+alternatives with ZERO changes to the prefill/decode/speculative programs
+or their signatures. The cost model matches how the features are used:
+nothing on the default path, one bucketed forward per request that asks.
 
-The pass runs in cache-bucket windows (W tokens per dispatch) so the
+Both passes share ONE windowed-cache driver (`_window_pass`): W tokens per
+dispatch against a bucket-sized running cache, so the scoring pass's
 logits buffer is [1, W, V] (~64 MB at Llama-3 vocab) instead of
-[1, S, V]; the top-K reduction happens on device and only [W, K+1] floats
-cross to the host per window.
+[1, S, V], and the embedding pass never materializes logits at all. Top-K
+reduces on device; only [W, K+1] floats (or one [D] row) cross to the
+host per window.
 
 Parity: the reference returns exactly what its upstream surface promises
 rather than approximations (responder envelope discipline,
 /root/reference/pkg/gofr/http/responder.go:24-50); here the promise is
-OpenAI's `logprobs` contract on /v1 completions + chat.
+OpenAI's `logprobs` / `embeddings` contracts on the /v1 surface.
 """
 
 from __future__ import annotations
@@ -29,14 +31,57 @@ from typing import List, Sequence, Tuple
 import numpy as np
 
 
+def _window_pass(engine, length: int, program_name: str, make_fn,
+                 window_args, collect, work_length=None) -> None:
+    """Shared windowed-cache driver for the post-hoc passes.
+
+    Owns the mechanics both passes must agree on — bucket selection, fp
+    cache init (the plain model forward, independent of the engine's
+    serving kv_dtype), W-sized zero-padded windows, broadcast positions,
+    and executor compilation with donated caches — so the passes cannot
+    silently diverge. Runs independently of the serving loop (no engine
+    state is touched; device execution interleaves with serving dispatches
+    under JAX's own serialization), so a busy server scores/embeds without
+    pausing decode.
+
+    make_fn(cfg, W) builds the window program (signature
+    (params, *extra, positions, k, v) -> (k, v, *outputs));
+    window_args(w0, n, W) returns the pass-specific extra arrays for the
+    window starting at w0 holding n live tokens; collect(w0, n, W, outs)
+    receives the outputs past (new_k, new_v). Padded tail positions
+    produce garbage the collectors slice away — causality guarantees they
+    cannot contaminate earlier positions.
+    """
+    import jax.numpy as jnp
+
+    from ..models.llama import init_kv_cache
+    from .executor import next_bucket
+
+    S = next_bucket(length, engine.prefill_buckets)
+    W = min(128, S)
+    k, v = init_kv_cache(engine.cfg, 1, S)
+    fn = make_fn(engine.cfg, W)
+    # work_length < length lets a pass skip trailing positions it never
+    # reads (scoring: position L-1 has no target, so an L ≡ 1 (mod W)
+    # sequence must not dispatch a whole discarded window for it)
+    for w0 in range(0, work_length or length, W):
+        n = min(W, length - w0)
+        positions = jnp.broadcast_to(
+            jnp.arange(w0, w0 + W, dtype=jnp.int32), (1, W))
+        args = (engine.params, *window_args(w0, n, W), positions, k, v)
+        program = engine.executor.compile(
+            f"{program_name}-{S}x{W}", fn, args,
+            donate_argnums=(len(args) - 2, len(args) - 1))
+        k, v, *outs = program(*args)
+        collect(w0, n, W, outs)
+
+
 def make_score_fn(cfg, W: int, K: int):
     """Window program: forward W tokens against the running cache, emit
     (new_k, new_v, chosen_lp [W], top_ids [W, K], top_lps [W, K]).
 
     `targets[j]` is the NEXT token after window position j (what the model
-    was asked to predict there); padded tail positions produce garbage
-    that the host slices away — causality guarantees they cannot
-    contaminate earlier positions."""
+    was asked to predict there)."""
     import jax
     import jax.numpy as jnp
 
@@ -52,22 +97,32 @@ def make_score_fn(cfg, W: int, K: int):
     return fn
 
 
+def make_embed_fn(cfg, W: int):
+    """Window program for embeddings: forward W tokens against the running
+    cache, emit (new_k, new_v, hidden [W, D]) — the final-norm hidden
+    states (llama_forward_hidden); the host takes the last live position's
+    row. No vocab projection at all: the [1, W, V] logits buffer never
+    exists on this pass."""
+    from ..models.llama import llama_forward_hidden
+
+    def fn(params, toks, positions, k, v):
+        hidden, k, v = llama_forward_hidden(params, cfg, toks, positions,
+                                            k, v)
+        return k, v, hidden[0]
+
+    return fn
+
+
 def score_tokens(engine, prompt_tokens: Sequence[int],
                  completion_tokens: Sequence[int], top: int = 5,
                  ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
     """Per-token logprobs for `completion_tokens` given `prompt_tokens`.
 
     Returns (chosen_lp [C], top_ids [C, top], top_lps [C, top]) as numpy.
-    Compiles one program per (cache bucket, window, top) triple through the
-    engine's executor — bounded like every other program family. Runs
-    independently of the serving loop (no engine state is touched; device
-    execution interleaves with serving dispatches under JAX's own
-    serialization), so a busy server can score without pausing decode.
+    Compiles one program per (cache bucket, window, top) triple through
+    the engine's executor — bounded like every other program family.
     """
     import jax.numpy as jnp
-
-    from ..models.llama import init_kv_cache
-    from .executor import next_bucket
 
     if not completion_tokens:
         raise ValueError("completion_tokens must be non-empty")
@@ -77,42 +132,72 @@ def score_tokens(engine, prompt_tokens: Sequence[int],
     P, L = len(prompt_tokens), len(seq)
     if P < 1:
         raise ValueError("prompt_tokens must be non-empty")
-    buckets = engine.prefill_buckets
-    if L > buckets[-1]:
+    if L > engine.prefill_buckets[-1]:
         raise ValueError(f"prompt+completion of {L} tokens exceeds the "
-                         f"largest scoring bucket ({buckets[-1]})")
-    S = next_bucket(L, buckets)
-    W = min(128, S)
-    cfg = engine.cfg
-    # fp cache regardless of the engine's serving kv_dtype: this is the
-    # plain model forward, not the quantized serving cache
-    k, v = init_kv_cache(cfg, 1, S)
+                         f"largest scoring bucket "
+                         f"({engine.prefill_buckets[-1]})")
 
     chosen_parts: List[np.ndarray] = []
     ids_parts: List[np.ndarray] = []
     lps_parts: List[np.ndarray] = []
-    fn = make_score_fn(cfg, W, top)
-    # windows cover positions [0, L-1); position j predicts seq[j+1], so
-    # the last position that matters is L-2
-    for w0 in range(0, L - 1, W):
+
+    def window_args(w0, n, W):
         toks = np.zeros((1, W), dtype=np.int32)
         targets = np.zeros((1, W), dtype=np.int32)
-        n = min(W, L - w0)          # tokens fed this window
         toks[0, :n] = seq[w0:w0 + n]
-        m = min(W, L - 1 - w0)      # positions with a real target
+        m = min(W, L - 1 - w0)  # positions with a real target
         targets[0, :m] = seq[w0 + 1:w0 + 1 + m]
-        positions = jnp.broadcast_to(
-            jnp.arange(w0, w0 + W, dtype=jnp.int32), (1, W))
-        args = (engine.params, jnp.asarray(toks), jnp.asarray(targets),
-                positions, k, v)
-        program = engine.executor.compile(
-            f"score-{S}x{W}k{top}", fn, args, donate_argnums=(4, 5))
-        k, v, chosen, top_ids, top_lps = program(*args)
+        return jnp.asarray(toks), jnp.asarray(targets)
+
+    def collect(w0, n, W, outs):
+        m = min(W, L - 1 - w0)
+        if m <= 0:
+            return
+        chosen, top_ids, top_lps = outs
         chosen_parts.append(np.asarray(chosen)[:m])
         ids_parts.append(np.asarray(top_ids)[:m])
         lps_parts.append(np.asarray(top_lps)[:m])
+
+    _window_pass(engine, L, f"score-k{top}",
+                 lambda cfg, W: make_score_fn(cfg, W, top),
+                 window_args, collect, work_length=L - 1)
 
     chosen = np.concatenate(chosen_parts)[P - 1:L - 1]
     ids = np.concatenate(ids_parts)[P - 1:L - 1]
     lps = np.concatenate(lps_parts)[P - 1:L - 1]
     return chosen, ids, lps
+
+
+def embed_tokens(engine, tokens: Sequence[int],
+                 normalize: bool = True) -> np.ndarray:
+    """Sequence embedding: the final-norm hidden state at the LAST
+    position (the causal summary of the whole sequence — the pooling
+    E5-Mistral-style decoder embedders use), optionally L2-normalized
+    (the OpenAI /v1/embeddings convention: unit-length vectors). Returns
+    float32 [D]."""
+    import jax.numpy as jnp
+
+    if not tokens:
+        raise ValueError("tokens must be non-empty")
+    L = len(tokens)
+    if L > engine.prefill_buckets[-1]:
+        raise ValueError(f"input of {L} tokens exceeds the largest "
+                         f"embedding bucket ({engine.prefill_buckets[-1]})")
+    out = {}
+
+    def window_args(w0, n, W):
+        toks = np.zeros((1, W), dtype=np.int32)
+        toks[0, :n] = tokens[w0:w0 + n]
+        return (jnp.asarray(toks),)
+
+    def collect(w0, n, W, outs):
+        if w0 + W >= L:  # the window holding position L-1
+            out["last"] = np.asarray(outs[0][L - 1 - w0], dtype=np.float32)
+
+    _window_pass(engine, L, "embed", make_embed_fn, window_args, collect)
+    last = out["last"]
+    if normalize:
+        norm = float(np.linalg.norm(last))
+        if norm > 0.0:
+            last = last / norm
+    return last

@@ -282,3 +282,90 @@ def test_score_under_tensor_parallel_mesh():
     finally:
         eng_tp.stop()
         eng_1.stop()
+
+
+def test_embed_matches_hidden_oracle(engine):
+    """engine.embed == the last row of llama_forward_hidden, normalized;
+    windowing (>128 tokens) must not change it."""
+    import jax.numpy as jnp
+
+    from gofr_tpu.models.llama import llama_forward_hidden
+
+    rng = np.random.default_rng(7)
+    for L in (5, 140):  # single-window and window-crossing
+        toks = rng.integers(1, CFG.vocab_size, size=L).tolist()
+        got = engine.embed(toks)
+
+        k, v = init_kv_cache(CFG, 1, L)
+        positions = jnp.broadcast_to(jnp.arange(L, dtype=jnp.int32), (1, L))
+        hidden, _, _ = llama_forward_hidden(
+            engine.params, CFG, jnp.asarray([toks], dtype=jnp.int32),
+            positions, k, v)
+        want = np.asarray(hidden[0, -1], dtype=np.float32)
+        want = want / np.linalg.norm(want)
+
+        assert got.shape == (CFG.dim,)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.linalg.norm(got), 1.0, rtol=1e-5)
+
+    with pytest.raises(ValueError):
+        engine.embed([])
+
+
+def test_openai_embeddings_endpoint():
+    import base64
+    import importlib.util
+    import json as _json
+    import os
+    import urllib.request
+
+    from gofr_tpu.config import MockConfig
+
+    path = os.path.join(os.path.dirname(__file__), "..", "examples",
+                        "openai-server", "main.py")
+    spec = importlib.util.spec_from_file_location("oai_emb_example", path)
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    app = module.build_app(config=MockConfig({
+        "HTTP_PORT": "0", "METRICS_PORT": "0", "APP_NAME": "emb",
+        "TPU_PLATFORM": "cpu", "MODEL_PRESET": "debug", "WARMUP": "false",
+        "REQUEST_TIMEOUT": "60"}))
+    app.start()
+
+    def call(body):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{app.http_port}/v1/embeddings", method="POST",
+            data=_json.dumps(body).encode())
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                return resp.status, _json.loads(resp.read().decode())
+        except urllib.error.HTTPError as err:
+            return err.code, _json.loads(err.read().decode() or "null")
+
+    try:
+        status, body = call({"input": ["hello world", "hello world", "bye"]})
+        assert status == 201, body
+        assert body["object"] == "list" and len(body["data"]) == 3
+        d = CFG.dim
+        e0, e1, e2 = (body["data"][i]["embedding"] for i in range(3))
+        assert len(e0) == d
+        assert e0 == e1          # deterministic: same input, same vector
+        assert e0 != e2
+        assert abs(sum(x * x for x in e0) - 1.0) < 1e-3  # unit length
+        assert body["usage"]["total_tokens"] > 0
+
+        # base64 wire format round-trips to the float values
+        status, b64body = call({"input": "hello world",
+                                "encoding_format": "base64"})
+        assert status == 201
+        decoded = np.frombuffer(
+            base64.b64decode(b64body["data"][0]["embedding"]), dtype="<f4")
+        np.testing.assert_allclose(decoded, np.asarray(e0, dtype=np.float32),
+                                   atol=1e-6)
+
+        assert call({"input": []})[0] == 400
+        assert call({"input": ""})[0] == 400
+        assert call({"input": "x", "encoding_format": "int8"})[0] == 400
+        assert call({"input": "y" * 4000})[0] == 400  # over the bucket cap
+    finally:
+        app.shutdown()
