@@ -200,6 +200,14 @@ def build_engine(app: App, default_sampling_controls: bool = False) -> LLMEngine
         engine.warmup(k_variants=warm_mode == "wide")
         app.logger.infof("engine warmed up in %.1fs%s", time.time() - t0,
                          " (wide)" if warm_mode == "wide" else "")
+    # WARMUP_SCORE=true pre-compiles the logprobs/embeddings families so
+    # the first client request never pays a compile under its deadline
+    # (off by default: deployments that never score keep the lean boot)
+    if app.config.get_bool("WARMUP_SCORE", False):
+        t0 = time.time()
+        n = engine.warmup_scoring()
+        app.logger.infof("scoring warmed up in %.1fs (%d passes)",
+                         time.time() - t0, n)
     # /.well-known/health reports the engine next to the datasources: a
     # wedged device (loop stuck in a PJRT call) degrades the aggregate so
     # load balancers stop routing here, matching submit()'s 503 shed.

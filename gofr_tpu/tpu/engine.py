@@ -794,6 +794,16 @@ class LLMEngine:
 
         return embed_tokens(self, tokens, normalize=normalize)
 
+    def warmup_scoring(self, embeddings: bool = True) -> int:
+        """Pre-compile the logprobs/embeddings program families (one
+        window program per cache bucket, covering every client top value)
+        so the first client request never pays a compile under its
+        deadline. Opt-in at boot — the serving warmup() stays lean for
+        deployments that never score."""
+        from .score import warmup_post_hoc
+
+        return warmup_post_hoc(self, embeddings=embeddings)
+
     def start(self) -> None:
         if self._thread is not None:
             return

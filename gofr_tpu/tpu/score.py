@@ -113,21 +113,28 @@ def make_embed_fn(cfg, W: int):
     return fn
 
 
+# every scoring program computes the MAXIMUM top-K and the host slices to
+# the requested `top`: the extra lanes cost nothing next to the forward,
+# and it keeps the program family keyed by bucket alone — so one warmup
+# pass per bucket covers every client top value (no per-top cache misses)
+_SCORE_K = 20
+
+
 def score_tokens(engine, prompt_tokens: Sequence[int],
                  completion_tokens: Sequence[int], top: int = 5,
                  ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
     """Per-token logprobs for `completion_tokens` given `prompt_tokens`.
 
     Returns (chosen_lp [C], top_ids [C, top], top_lps [C, top]) as numpy.
-    Compiles one program per (cache bucket, window, top) triple through
-    the engine's executor — bounded like every other program family.
+    Compiles one program per (cache bucket, window) pair through the
+    engine's executor — bounded like every other program family.
     """
     import jax.numpy as jnp
 
     if not completion_tokens:
         raise ValueError("completion_tokens must be non-empty")
-    if not 1 <= top <= 20:
-        raise ValueError(f"top must be in [1, 20], got {top}")
+    if not 1 <= top <= _SCORE_K:
+        raise ValueError(f"top must be in [1, {_SCORE_K}], got {top}")
     seq = list(prompt_tokens) + list(completion_tokens)
     P, L = len(prompt_tokens), len(seq)
     if P < 1:
@@ -158,13 +165,13 @@ def score_tokens(engine, prompt_tokens: Sequence[int],
         ids_parts.append(np.asarray(top_ids)[:m])
         lps_parts.append(np.asarray(top_lps)[:m])
 
-    _window_pass(engine, L, f"score-k{top}",
-                 lambda cfg, W: make_score_fn(cfg, W, top),
+    _window_pass(engine, L, "score",
+                 lambda cfg, W: make_score_fn(cfg, W, _SCORE_K),
                  window_args, collect, work_length=L - 1)
 
     chosen = np.concatenate(chosen_parts)[P - 1:L - 1]
-    ids = np.concatenate(ids_parts)[P - 1:L - 1]
-    lps = np.concatenate(lps_parts)[P - 1:L - 1]
+    ids = np.concatenate(ids_parts)[P - 1:L - 1, :top]
+    lps = np.concatenate(lps_parts)[P - 1:L - 1, :top]
     return chosen, ids, lps
 
 
@@ -201,3 +208,22 @@ def embed_tokens(engine, tokens: Sequence[int],
         if norm > 0.0:
             last = last / norm
     return last
+
+
+def warmup_post_hoc(engine, embeddings: bool = True) -> int:
+    """Pre-compile the scoring (and optionally embedding) program families
+    — one window program per cache bucket — so the first client logprobs/
+    embeddings request never pays a compile under its REQUEST_TIMEOUT
+    (docs/serving.md's warm-at-boot recipe, as an API). Covers EVERY
+    client `top` value: the scoring program always computes _SCORE_K lanes
+    and the host slices (see _SCORE_K). Returns the number of passes run.
+    Cost: one bucket-length forward per bucket per family, once per boot,
+    amortized across boots by PROGRAM_CACHE_DIR."""
+    ran = 0
+    for S in engine.prefill_buckets:
+        score_tokens(engine, [1] * max(1, S - 1), [1])
+        ran += 1
+        if embeddings:
+            embed_tokens(engine, [1] * S)
+            ran += 1
+    return ran

@@ -369,3 +369,25 @@ def test_openai_embeddings_endpoint():
         assert call({"input": "y" * 4000})[0] == 400  # over the bucket cap
     finally:
         app.shutdown()
+
+
+def test_warmup_scoring_precompiles_every_bucket():
+    """After warmup_scoring, client score/embed calls at any bucket hit
+    compiled programs — the executor cache does not grow."""
+    eng = LLMEngine(llama_init(CFG, seed=0), CFG, n_slots=2, max_seq_len=64,
+                    prefill_buckets=(16, 32))
+    eng.start()
+    try:
+        ran = eng.warmup_scoring()
+        assert ran == 4  # (score + embed) x 2 buckets
+        size = eng.executor.cache_size
+        # EVERY client top value must hit the warmed programs (the program
+        # always computes the max K; the host slices) — top=1 is the most
+        # common client path (chat logprobs without top_logprobs)
+        eng.score([1, 2, 3], [4, 5], top=1)
+        eng.score([1, 2, 3], [4, 5], top=5)
+        eng.score([1] * 20, [9] * 8, top=20)  # second bucket, max top
+        eng.embed([7, 8, 9])
+        assert eng.executor.cache_size == size  # nothing new compiled
+    finally:
+        eng.stop()
