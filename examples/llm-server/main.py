@@ -56,6 +56,21 @@ def _load_tokenizer(path: str):
     return BPETokenizer.from_file(path)
 
 
+def _register_engine_observability(app: App, engine) -> None:
+    """The engine's two pull-based surfaces, registered by EVERY
+    construction path (built or injected): /.well-known/health reports the
+    engine next to the datasources (a wedged device degrades the aggregate
+    so load balancers stop routing here, matching submit()'s 503 shed),
+    and the stall gauge refreshes at metrics-scrape time (a wedged loop
+    cannot push its own metric). Both registrations are name-keyed and
+    idempotent."""
+    app.container.add_health_contributor("engine", engine.health_check)
+    m = app.container.metrics_manager
+    if m is not None:
+        app.container.add_scrape_hook("engine_stall", lambda: m.set_gauge(
+            "app_tpu_engine_stall_seconds", round(engine.stall_seconds, 1)))
+
+
 def build_engine(app: App, default_sampling_controls: bool = False) -> LLMEngine:
     tpu = TPUClient(app.config)
     app.add_tpu(tpu)
@@ -213,7 +228,7 @@ def build_engine(app: App, default_sampling_controls: bool = False) -> LLMEngine
     # load balancers stop routing here, matching submit()'s 503 shed.
     # Registered here so every server built on this engine (llm-server,
     # openai-server) gets it, not just the /generate surface.
-    app.container.add_health_contributor("engine", engine.health_check)
+    _register_engine_observability(app, engine)
     return engine
 
 
@@ -285,9 +300,9 @@ def build_app(config=None, engine=None) -> App:
     elif getattr(engine, "tokenizer", None) is None:
         engine.tokenizer = ByteTokenizer()
     app.engine = engine
-    # idempotent when build_engine already registered it (dict keyed by
-    # name); covers the injected-engine path (tests) too
-    app.container.add_health_contributor("engine", engine.health_check)
+    # idempotent when build_engine already registered them (both are
+    # name-keyed); covers the injected-engine path (tests) too
+    _register_engine_observability(app, engine)
     tokenizer: ByteTokenizer = engine.tokenizer
     # token streaming over gRPC rides the same engine (GRPC_PORT)
     app.register_grpc_service(build_generate_service(engine, tokenizer))

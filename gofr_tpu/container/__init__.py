@@ -45,6 +45,10 @@ class Container:
         # app-level components in the aggregate health report (the serving
         # engines register here; see add_health_contributor)
         self._health_contributors: Dict[str, Any] = {}
+        # name-keyed callables run at every metrics scrape (see
+        # add_scrape_hook); a dict so re-registration is idempotent, like
+        # the health contributors
+        self._scrape_hooks: Dict[str, Any] = {}
         self.app_name = config.get_or_default("APP_NAME", "gofr-tpu-app")
         self.app_version = config.get_or_default("APP_VERSION", "dev")
         self._started_at = time.time()
@@ -142,6 +146,15 @@ class Container:
         m.new_counter("app_pubsub_commit_total_count", "messages committed")
         m.new_counter("app_pubsub_subscribe_failure_count", "handler failures")
 
+    def add_scrape_hook(self, name: str, fn) -> None:
+        """fn() runs at every metrics scrape — for gauges whose owner
+        cannot push them (the engine's stall gauge: a loop stuck inside a
+        wedged device call cannot update its own metric, so the scrape
+        pulls the host-side reading instead). Name-keyed: re-registering
+        replaces, so every engine-construction path can register without
+        duplicate hooks."""
+        self._scrape_hooks[name] = fn
+
     def refresh_runtime_metrics(self) -> None:
         """Refreshed per metrics scrape (metrics/handler.go:21-35)."""
         m = self.metrics_manager
@@ -150,6 +163,12 @@ class Container:
         m.set_gauge("app_python_threads", threading.active_count())
         m.set_gauge("app_python_gc_objects", len(gc.get_objects()) if gc.isenabled() else 0)
         m.set_gauge("app_uptime_seconds", time.time() - self._started_at)
+        for hook in self._scrape_hooks.values():
+            try:
+                hook()
+            except Exception as exc:  # noqa: BLE001 - a broken hook must
+                # never break the scrape (every exporter would go blind)
+                self.logger.errorf("scrape hook failed: %s", exc)
 
     # -- accessors ------------------------------------------------------------
     def metrics(self) -> MetricsManager:

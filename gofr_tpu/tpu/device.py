@@ -127,6 +127,10 @@ class TPUClient:
             ("app_tpu_hbm_bytes_limit", "HBM bytes available per device"),
             ("app_tpu_tokens_per_second", "rolling decode throughput"),
             ("app_tpu_pages_used", "KV pool pages currently owned by slots"),
+            ("app_tpu_engine_stall_seconds",
+             "seconds the engine loop has been stuck inside one device "
+             "call (0 = healthy); scrape-time, set by a container scrape "
+             "hook because a wedged loop cannot push its own metric"),
         ):
             try:
                 m.new_gauge(name, desc)

@@ -169,3 +169,36 @@ def test_grpc_maps_shed_errors_to_unavailable():
             is grpc.StatusCode.INVALID_ARGUMENT)
     assert (server._status_for(RuntimeError("boom"))
             is grpc.StatusCode.INTERNAL)
+
+
+def test_stall_gauge_refreshes_at_scrape():
+    """app_tpu_engine_stall_seconds is pulled by a container scrape hook —
+    the one metric the engine loop can never push itself (a wedged loop is
+    stuck inside the device call)."""
+    from gofr_tpu import new_mock_container
+
+    container = new_mock_container()
+    m = container.metrics_manager
+    m.new_gauge("app_tpu_engine_stall_seconds", "test")
+
+    class FakeEngine:
+        stall_seconds = 0.0
+
+    eng = FakeEngine()
+    container.add_scrape_hook("engine_stall", lambda: m.set_gauge(
+        "app_tpu_engine_stall_seconds", round(eng.stall_seconds, 1)))
+    # idempotent: a second registration under the same name replaces
+    container.add_scrape_hook("engine_stall", lambda: m.set_gauge(
+        "app_tpu_engine_stall_seconds", round(eng.stall_seconds, 1)))
+    assert len(container._scrape_hooks) == 1
+
+    container.refresh_runtime_metrics()
+    assert m.get("app_tpu_engine_stall_seconds").series[tuple()] == 0.0
+    eng.stall_seconds = 42.2
+    container.refresh_runtime_metrics()
+    assert m.get("app_tpu_engine_stall_seconds").series[tuple()] == 42.2
+
+    # a broken hook must never break the scrape
+    container.add_scrape_hook("broken",
+                              lambda: (_ for _ in ()).throw(RuntimeError()))
+    container.refresh_runtime_metrics()  # does not raise
