@@ -303,6 +303,12 @@ def build_app(config=None, engine=None) -> App:
     # idempotent when build_engine already registered them (both are
     # name-keyed); covers the injected-engine path (tests) too
     _register_engine_observability(app, engine)
+    # FLIGHT_RECORDER=false opts out of the per-request timeline surface
+    # (GET /debug/requests, engine child spans, SLO goodput gauges); an
+    # engine injected with its own recorder keeps it — enable_ only wires
+    # the app's metrics/tracer sinks and the routes then
+    if app.config.get_bool("FLIGHT_RECORDER", True):
+        app.enable_flight_recorder(engine)
     tokenizer: ByteTokenizer = engine.tokenizer
     # token streaming over gRPC rides the same engine (GRPC_PORT)
     app.register_grpc_service(build_generate_service(engine, tokenizer))
@@ -334,6 +340,7 @@ def build_app(config=None, engine=None) -> App:
                 tokenizer.encode(prompt), max_new_tokens=max_tokens,
                 temperature=temperature, stop_tokens={tokenizer.EOS},
                 span=ctx.span,  # batch.id/slot correlation lands on span
+                traceparent=ctx.request.traceparent,  # engine child spans
                 priority=priority, min_tokens=min_tokens, top_p=top_p,
                 top_k=top_k)
         except ValueError as exc:
@@ -391,6 +398,9 @@ def build_app(config=None, engine=None) -> App:
         prefix = getattr(engine, "prefix", None)
         if prefix is not None:
             out["prefix_cache"] = prefix.stats()
+        recorder = getattr(engine, "recorder", None)
+        if recorder is not None:
+            out["slo"] = recorder.slo_stats()
         return out
 
     return app

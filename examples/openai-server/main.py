@@ -69,6 +69,10 @@ def build_app(**kw) -> App:
     # top_p (SAMPLING_CONTROLS=false trades that for a leaner sampler)
     engine = build_engine(app, default_sampling_controls=True)
     app.engine = engine    # reachable for operators/tests (llm-server parity)
+    # per-request flight recorder + /debug/requests + SLO goodput gauges
+    # (llm-server parity; FLIGHT_RECORDER=false opts out)
+    if app.config.get_bool("FLIGHT_RECORDER", True):
+        app.enable_flight_recorder(engine)
     tokenizer = engine.tokenizer
     model_id = app.config.get_or_default("MODEL_PRESET", "debug")
 
@@ -142,10 +146,16 @@ def build_app(**kw) -> App:
 
     def _submit_tokens(prompt_tokens, max_tokens: int, temperature: float,
                        min_tokens: int = 0, top_p: float = 0.0,
-                       top_k: int = 0):
+                       top_k: int = 0, ctx=None):
+        # ctx threads the caller's trace context through to the engine so
+        # the flight recorder's engine child spans (queue/prefill/decode)
+        # share the inbound trace id
         return engine.submit(prompt_tokens, max_new_tokens=max_tokens,
                              temperature=temperature,
                              stop_tokens={tokenizer.EOS},
+                             span=ctx.span if ctx is not None else None,
+                             traceparent=(ctx.request.traceparent
+                                          if ctx is not None else None),
                              min_tokens=min_tokens, top_p=top_p, top_k=top_k)
 
     def _finish_reason(n_emitted: int, max_tokens: int) -> str:
@@ -305,7 +315,7 @@ def build_app(**kw) -> App:
             for _ in range(n_choices):
                 requests.append(_submit_tokens(prompt_toks, max_tokens,
                                                temperature, min_tokens,
-                                               top_p, top_k))
+                                               top_p, top_k, ctx=ctx))
             for idx, req in enumerate(requests):
                 try:
                     tokens = req.result(timeout_s=ctx.remaining())
@@ -386,7 +396,7 @@ def build_app(**kw) -> App:
         if lp_n is not None:
             _check_scoreable(len(prompt_toks), max_tokens)
         request = _submit_tokens(prompt_toks, max_tokens, temperature,
-                                 min_tokens, top_p, top_k)
+                                 min_tokens, top_p, top_k, ctx=ctx)
         created = int(time.time())
         rid = (f"chatcmpl-{uuid.uuid4().hex[:24]}" if chat
                else f"cmpl-{uuid.uuid4().hex[:24]}")

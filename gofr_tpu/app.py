@@ -246,6 +246,41 @@ class App:
 
         install_routes(self, path)
 
+    def enable_flight_recorder(self, engine, path: str = "/debug/requests"):
+        """Attach a per-request flight recorder to `engine` and expose its
+        operator endpoints (tpu/flightrecorder.py): GET /debug/requests
+        (in-flight + recent completions with phase timings + SLO goodput)
+        and GET /debug/requests/{id} (one request's full timeline). Also
+        registers the app_tpu_slo_*_goodput gauges on the metrics Manager.
+
+        Config: FLIGHT_RECORDER_CAPACITY (completed-request ring size,
+        default 256), FLIGHT_RECORDER_MAX_EVENTS (per-request event cap,
+        default 512), SLO_TTFT_TARGET_S / SLO_TPOT_TARGET_S (goodput
+        targets, defaults 0.15 / 0.05). An engine built with its own
+        flight_recorder= keeps it; this call then only wires the app's
+        metrics/tracer sinks and the routes. Returns the recorder."""
+        from .tpu.flightrecorder import (FlightRecorder, install_routes,
+                                         register_slo_gauges)
+
+        recorder = getattr(engine, "recorder", None)
+        if recorder is None:
+            recorder = FlightRecorder(
+                capacity=self.config.get_int("FLIGHT_RECORDER_CAPACITY", 256),
+                max_events=self.config.get_int(
+                    "FLIGHT_RECORDER_MAX_EVENTS", 512),
+                slo_ttft_s=self.config.get_float("SLO_TTFT_TARGET_S", 0.150),
+                slo_tpot_s=self.config.get_float("SLO_TPOT_TARGET_S", 0.050),
+                metrics=self.container.metrics_manager,
+                tracer=self.container.tracer)
+            engine.recorder = recorder
+        else:
+            recorder.use_metrics(self.container.metrics_manager)
+            recorder.use_tracer(self.container.tracer)
+        if self.container.metrics_manager is not None:
+            register_slo_gauges(self.container.metrics_manager)
+        install_routes(self, recorder, path)
+        return recorder
+
     # -- cross-cutting registrations ------------------------------------------
     def add_http_service(self, name: str, address: str, *options) -> None:
         from .service import new_http_service

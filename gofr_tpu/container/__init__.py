@@ -52,6 +52,9 @@ class Container:
         self.app_name = config.get_or_default("APP_NAME", "gofr-tpu-app")
         self.app_version = config.get_or_default("APP_VERSION", "dev")
         self._started_at = time.time()
+        # consecutive health() calls that saw a DEGRADED (not DOWN)
+        # contributor — see health() for the de-flap rule
+        self._degraded_streak = 0
 
     # -- construction ---------------------------------------------------------
     @classmethod
@@ -235,8 +238,22 @@ class Container:
             details[name] = h.to_dict() if isinstance(h, Health) else h
             statuses.append(h.status if isinstance(h, Health)
                             else h.get("status", STATUS_DOWN))
-        if any(s in (STATUS_DOWN, STATUS_DEGRADED) for s in statuses):
+        # de-flap (ADVICE r5): DOWN degrades the aggregate immediately, but
+        # a DEGRADED contributor must persist across >= 2 consecutive
+        # checks — a single slow device probe (first-probe compile, a 3s
+        # timeout under momentary load) must not make a load balancer pull
+        # a healthy node off rotation
+        if any(s == STATUS_DOWN for s in statuses):
+            self._degraded_streak = 0
             out["status"] = STATUS_DEGRADED
+        elif any(s == STATUS_DEGRADED for s in statuses):
+            self._degraded_streak += 1
+            if self._degraded_streak >= 2:
+                out["status"] = STATUS_DEGRADED
+            else:
+                out["degrading"] = True  # visible, but not yet actionable
+        else:
+            self._degraded_streak = 0
         out["details"] = details
         return out
 

@@ -37,9 +37,15 @@ def _is_well_known(request: Request) -> bool:
 def tracer_middleware(tracer) -> Callable[[WireHandler], WireHandler]:
     def mw(inner: WireHandler) -> WireHandler:
         def handle(request: Request) -> Response:
+            # keep the raw header too: handlers thread it through
+            # engine.submit(traceparent=...) so the flight recorder can
+            # parent engine child spans under the caller's trace even
+            # after this span has closed (streamed responses end it
+            # before admission)
+            request.traceparent = request.headers.get("traceparent")
             span = tracer.start_span(
                 f"{request.method} {request.path}",
-                traceparent=request.headers.get("traceparent"),
+                traceparent=request.traceparent,
             )
             span.set_attribute("http.method", request.method)
             span.set_attribute("http.target", request.path)

@@ -44,6 +44,7 @@ class Request:
         self.path_params: Dict[str, str] = {}
         self.route_pattern: Optional[str] = None  # set by the router on match
         self.span = None  # set by tracer middleware
+        self.traceparent: Optional[str] = None  # raw W3C header, ditto
         self.auth_subject: Optional[str] = None  # set by auth middleware
         self.context: Dict[str, Any] = {}  # request-scoped values
 

@@ -58,7 +58,14 @@ class TPUClient:
         self._devices: List[Any] = []
         self._connected_at: Optional[float] = None
         self._jax = None
-        # single-flight health probe state (see health_check)
+        # single-flight health probe state (see health_check); the lock
+        # serializes probe start/result reads — without it two concurrent
+        # health polls can both observe a dead probe thread, both reset
+        # _probe_result, and one then unpacks None after join (spurious
+        # DOWN flap, ADVICE r5)
+        import threading
+
+        self._probe_lock = threading.Lock()
         self._probe_thread = None
         self._probe_result = None
 
@@ -131,6 +138,12 @@ class TPUClient:
              "seconds the engine loop has been stuck inside one device "
              "call (0 = healthy); scrape-time, set by a container scrape "
              "hook because a wedged loop cannot push its own metric"),
+            ("app_tpu_slo_ttft_goodput",
+             "fraction of recent requests meeting the TTFT target "
+             "(flight recorder rolling window)"),
+            ("app_tpu_slo_tpot_goodput",
+             "fraction of recent requests meeting the TPOT target "
+             "(flight recorder rolling window)"),
         ):
             try:
                 m.new_gauge(name, desc)
@@ -236,23 +249,30 @@ class TPUClient:
 
         # single-flight: while one probe is still blocked inside the
         # device, health polls reuse it (reporting DEGRADED) rather than
-        # piling up a stuck thread per poll
-        probe = self._probe_thread
-        if probe is None or not probe.is_alive():
-            self._probe_result = None
-            probe = threading.Thread(target=self._probe_device,
-                                     name="tpu-health-probe", daemon=True)
-            self._probe_thread = probe
-            probe.start()
+        # piling up a stuck thread per poll. Start/result are guarded by
+        # _probe_lock so concurrent polls cannot double-start a probe or
+        # reset the result another poll is about to read
+        with self._probe_lock:
+            probe = self._probe_thread
+            if probe is None or not probe.is_alive():
+                self._probe_result = None
+                probe = threading.Thread(target=self._probe_device,
+                                         name="tpu-health-probe", daemon=True)
+                self._probe_thread = probe
+                probe.start()
         probe.join(timeout=self.HEALTH_PROBE_TIMEOUT_S)
-        if probe.is_alive():
+        with self._probe_lock:
+            result = self._probe_result
+        if probe.is_alive() or result is None:
+            # still blocked inside the device — or finished the join race
+            # without a published result yet: degraded, never a crash
             return Health(status=STATUS_DEGRADED, details={
                 "platform": self.platform,
                 "error": f"device probe stuck for "
                          f">{self.HEALTH_PROBE_TIMEOUT_S:.0f}s "
                          f"(runtime not answering)",
             })
-        status, err = self._probe_result
+        status, err = result
         if status == STATUS_DOWN:
             return Health(status=STATUS_DOWN, details={"error": err})
         self.refresh_memory_metrics()

@@ -205,7 +205,7 @@ class PagedLLMEngine(LLMEngine):
                temperature: float = 0.0, stop_tokens=None,
                span=None, priority: int = 0,
                min_tokens: int = 0, top_p: float = 0.0,
-               top_k: int = 0) -> GenerationRequest:
+               top_k: int = 0, traceparent=None) -> GenerationRequest:
         """Reject requests whose reservation could NEVER fit the pool:
         parking them would permanently occupy the admission heap's head
         for their priority class behind an allocation that cannot
@@ -221,7 +221,7 @@ class PagedLLMEngine(LLMEngine):
         return super().submit(prompt_tokens, max_new_tokens, temperature,
                               stop_tokens, span=span, priority=priority,
                               min_tokens=min_tokens, top_p=top_p,
-                              top_k=top_k)
+                              top_k=top_k, traceparent=traceparent)
 
     def _request_pages(self, request: GenerationRequest) -> int:
         total = min(len(request.prompt_tokens) + request.max_new_tokens,
@@ -257,6 +257,12 @@ class PagedLLMEngine(LLMEngine):
             pages = self.allocator.alloc(need)
         if pages is None:
             self._obs.counter("app_tpu_page_waits_total")
+            if self.recorder is not None:
+                # once per request: _admission_ready retries at loop speed
+                # while the pool is exhausted, and one timeline entry is
+                # the evidence an operator needs
+                self.recorder.record_event(request.id, "page_wait",
+                                           once=True, need=need)
             return False
         self._reservations[request.id] = pages
         return True

@@ -52,13 +52,21 @@ def _window_pass(engine, length: int, program_name: str, make_fn,
     produce garbage the collectors slice away — causality guarantees they
     cannot contaminate earlier positions.
     """
+    import math
+
     import jax.numpy as jnp
 
     from ..models.llama import init_kv_cache
     from .executor import next_bucket
 
     S = next_bucket(length, engine.prefill_buckets)
-    W = min(128, S)
+    # W must DIVIDE S: prefill buckets are config-controlled (the
+    # llm-server parses arbitrary ints), and a bucket like 192 would give
+    # the final 128-wide window positions past the S-length cache —
+    # "working" only by JAX's out-of-bounds scatter-drop while attention
+    # reads garbage. gcd(S, 128) always divides S; power-of-two buckets
+    # keep the full W=128 window (ADVICE r5).
+    W = math.gcd(S, 128)
     k, v = init_kv_cache(engine.cfg, 1, S)
     fn = make_fn(engine.cfg, W)
     # work_length < length lets a pass skip trailing positions it never

@@ -349,6 +349,27 @@ class Tracer:
             trace_id = _rand_hex(16)
         return Span(self, name, trace_id, parent_id)
 
+    def span_at(self, name: str, start_time: float, end_time: float,
+                parent: Optional[Span] = None,
+                trace_id: Optional[str] = None,
+                parent_id: Optional[str] = None,
+                attributes: Optional[Dict[str, Any]] = None) -> Span:
+        """Synthesize an already-finished span with explicit timestamps,
+        exported immediately. For after-the-fact reconstruction of phases
+        measured outside the tracer (the engine flight recorder builds
+        queue/prefill/decode child spans from a request's timeline once
+        it completes — the phases were timed by the engine, not by open
+        span objects)."""
+        if parent is not None:
+            trace_id, parent_id = parent.trace_id, parent.span_id
+        span = Span(self, name, trace_id or _rand_hex(16), parent_id)
+        span.start_time = float(start_time)
+        if attributes:
+            span.attributes.update(attributes)
+        span.end_time = max(float(start_time), float(end_time))
+        self._export(span)
+        return span
+
     def _export(self, span: Span) -> None:
         if self.sampled:
             try:

@@ -101,6 +101,13 @@ def test_container_health_contributor_degrades_aggregate():
     container.add_health_contributor(
         "engine", lambda: Health(status=STATUS_DEGRADED,
                                  details={"stall_seconds": 12.0}))
+    # de-flap: one DEGRADED check is visible but NOT yet actionable (a
+    # single slow probe must not get the node pulled); the second
+    # consecutive one degrades the aggregate
+    out = container.health()
+    assert out["status"] == STATUS_UP
+    assert out["degrading"] is True
+    assert out["details"]["engine"]["details"]["stall_seconds"] == 12.0
     out = container.health()
     assert out["status"] == STATUS_DEGRADED
     assert out["details"]["engine"]["details"]["stall_seconds"] == 12.0

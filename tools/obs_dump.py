@@ -1,0 +1,105 @@
+#!/usr/bin/env python3
+"""Poll the flight recorder + SLO gauges during a soak and append JSONL.
+
+Soak runs (tools/soak.py, tools/tpu_watch.sh) record aggregate
+throughput; this sidecar records the per-request TAIL evidence next to
+it — who is in flight, recent completions' phase timings, the SLO
+goodput fractions, and engine events (cache growth, resets, sheds) —
+so a blown-tail soak can be diagnosed after the fact instead of
+re-reproduced.
+
+Usage:
+    python tools/obs_dump.py [--server http://127.0.0.1:8000]
+                             [--metrics http://127.0.0.1:2121]
+                             [--interval 5] [--count 0]
+                             [--out obs_dump.jsonl]
+
+count 0 polls until interrupted. Failures are recorded as error entries
+and polling continues — a restarting server must not kill the watcher.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+import time
+import urllib.request
+
+SLO_GAUGES = ("app_tpu_slo_ttft_goodput", "app_tpu_slo_tpot_goodput",
+              "app_tpu_tokens_per_second", "app_tpu_engine_stall_seconds",
+              "app_tpu_active_slots", "app_tpu_queue_depth")
+
+
+def _get(url: str, timeout: float = 5.0) -> str:
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.read().decode()
+
+
+def scrape_gauges(metrics_base: str) -> dict:
+    """Pull the SLO/serving gauges out of the Prometheus exposition."""
+    text = _get(metrics_base.rstrip("/") + "/metrics")
+    out = {}
+    for name in SLO_GAUGES:
+        # value line: name{optional labels} <float>
+        m = re.search(rf"^{re.escape(name)}(?:\{{[^}}]*\}})? (\S+)$",
+                      text, re.MULTILINE)
+        if m is not None:
+            out[name] = float(m.group(1))
+    return out
+
+
+def poll_once(server: str, metrics_base: str) -> dict:
+    entry: dict = {"t": time.time()}
+    try:
+        body = json.loads(_get(server.rstrip("/") + "/debug/requests"))
+        flight = body.get("data", body)  # responder envelope or raw
+        entry["in_flight"] = flight.get("in_flight", [])
+        entry["recent"] = flight.get("recent", [])
+        entry["slo"] = flight.get("slo")
+        entry["engine_events"] = flight.get("engine_events", [])
+        entry["finished_total"] = flight.get("finished_total")
+    except Exception as exc:  # noqa: BLE001 - keep polling through restarts
+        entry["flight_error"] = str(exc)
+    try:
+        entry["gauges"] = scrape_gauges(metrics_base)
+    except Exception as exc:  # noqa: BLE001
+        entry["metrics_error"] = str(exc)
+    return entry
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--server", default="http://127.0.0.1:8000",
+                    help="app HTTP base (serves /debug/requests)")
+    ap.add_argument("--metrics", default="http://127.0.0.1:2121",
+                    help="metrics server base (serves /metrics)")
+    ap.add_argument("--interval", type=float, default=5.0)
+    ap.add_argument("--count", type=int, default=0,
+                    help="polls before exiting; 0 = until interrupted")
+    ap.add_argument("--out", default="obs_dump.jsonl",
+                    help="JSONL output path; '-' for stdout")
+    args = ap.parse_args()
+
+    fp = sys.stdout if args.out == "-" else open(args.out, "a",
+                                                 encoding="utf-8")
+    n = 0
+    try:
+        while True:
+            entry = poll_once(args.server, args.metrics)
+            fp.write(json.dumps(entry) + "\n")
+            fp.flush()
+            n += 1
+            if args.count and n >= args.count:
+                return 0
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        if fp is not sys.stdout:
+            fp.close()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

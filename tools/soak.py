@@ -138,7 +138,13 @@ def _soak(engine, seconds: float, n_threads: int, vocab: int) -> dict:
 
 def run_profile(profile: str, seconds: float, n_threads: int,
                 preset: str) -> bool:
+    from gofr_tpu.tpu.flightrecorder import FlightRecorder
+
     engine = _build(profile, preset)
+    # flight recorder: the soak's per-request TAIL evidence — the slowest
+    # completions' phase timings + SLO goodput land in the JSON artifact,
+    # so a blown-tail run is diagnosable without re-reproduction
+    engine.recorder = recorder = FlightRecorder(capacity=512)
     engine.start()
     engine.warmup()
     t0 = time.time()
@@ -149,6 +155,12 @@ def run_profile(profile: str, seconds: float, n_threads: int,
         engine.stop()
     stats.update(profile=profile, preset=preset,
                  seconds=round(time.time() - t0, 1), drained=drained)
+    snap = recorder.snapshot()
+    stats["slo"] = snap["slo"]
+    stats["engine_events"] = snap["engine_events"]
+    # the 5 slowest-TTFT completions, full phase breakdown each
+    with_ttft = [r for r in snap["recent"] if "ttft_s" in r]
+    stats["slowest_ttft"] = sorted(with_ttft, key=lambda r: -r["ttft_s"])[:5]
     ok = stats["errors"] == 0 and drained and stats["ok"] > 0
     leaked = None
     if hasattr(engine, "allocator"):
