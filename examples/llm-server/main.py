@@ -309,6 +309,11 @@ def build_app(config=None, engine=None) -> App:
     # the app's metrics/tracer sinks and the routes then
     if app.config.get_bool("FLIGHT_RECORDER", True):
         app.enable_flight_recorder(engine)
+    # fleet-level sibling: GET /debug/engine (slots / page pool / compile
+    # table / MFU-MBU utilization window) + HBM sampler; ENGINE_SNAPSHOT=
+    # false opts out
+    if app.config.get_bool("ENGINE_SNAPSHOT", True):
+        app.enable_engine_snapshot(engine)
     tokenizer: ByteTokenizer = engine.tokenizer
     # token streaming over gRPC rides the same engine (GRPC_PORT)
     app.register_grpc_service(build_generate_service(engine, tokenizer))

@@ -73,6 +73,10 @@ def build_app(**kw) -> App:
     # (llm-server parity; FLIGHT_RECORDER=false opts out)
     if app.config.get_bool("FLIGHT_RECORDER", True):
         app.enable_flight_recorder(engine)
+    # GET /debug/engine + utilization gauges + HBM sampler (llm-server
+    # parity; ENGINE_SNAPSHOT=false opts out)
+    if app.config.get_bool("ENGINE_SNAPSHOT", True):
+        app.enable_engine_snapshot(engine)
     tokenizer = engine.tokenizer
     model_id = app.config.get_or_default("MODEL_PRESET", "debug")
 

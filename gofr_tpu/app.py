@@ -281,6 +281,42 @@ class App:
         install_routes(self, recorder, path)
         return recorder
 
+    def enable_engine_snapshot(self, engine, path: str = "/debug/engine"):
+        """Expose the engine's fleet-level operator surface
+        (tpu/utilization.py): GET /debug/engine — one JSON snapshot of
+        slots / buckets / page pool / utilization window / compile table —
+        plus the utilization gauges (app_tpu_mfu / app_tpu_mbu /
+        app_tpu_device_duty_cycle / app_tpu_host_overhead_seconds) and a
+        background HBM / page-pool sampler.
+
+        Config: ENGINE_HBM_SAMPLE_S (sampler cadence, default 10 s; <= 0
+        disables the background thread — the gauges still refresh at every
+        metrics scrape). TPU_PEAK_FLOPS / TPU_PEAK_HBM_BW override the
+        per-device peak table the MFU/MBU math divides by. Returns the
+        engine's UtilizationLedger (or None for engines without one)."""
+        from .tpu.utilization import (MemorySampler,
+                                      install_routes as install_engine_routes,
+                                      register_utilization_metrics)
+
+        metrics = self.container.metrics_manager
+        if metrics is not None:
+            register_utilization_metrics(metrics)
+        util = getattr(engine, "util", None)
+        if util is not None:
+            util.use_metrics(metrics)
+            # scrape-time republish: an idle engine's duty cycle must decay
+            # to zero, not freeze at the last dispatch's value
+            self.container.add_scrape_hook("engine_util", util.publish)
+        install_engine_routes(self, engine, path)
+        interval = self.config.get_float("ENGINE_HBM_SAMPLE_S", 10.0)
+        if interval > 0:
+            sampler = MemorySampler(metrics, tpu=self.container.tpu,
+                                    engine=engine, interval_s=interval,
+                                    logger=self.logger)
+            sampler.start()
+            self.on_shutdown(sampler.stop)
+        return util
+
     # -- cross-cutting registrations ------------------------------------------
     def add_http_service(self, name: str, address: str, *options) -> None:
         from .service import new_http_service

@@ -166,6 +166,14 @@ class Container:
         m.set_gauge("app_python_threads", threading.active_count())
         m.set_gauge("app_python_gc_objects", len(gc.get_objects()) if gc.isenabled() else 0)
         m.set_gauge("app_uptime_seconds", time.time() - self._started_at)
+        if self.tpu is not None and hasattr(self.tpu, "refresh_memory_metrics"):
+            # scrape-time HBM refresh: memory_stats is a host-side PJRT
+            # read (no device round-trip), so every scrape sees current
+            # occupancy even between MemorySampler intervals
+            try:
+                self.tpu.refresh_memory_metrics()
+            except Exception as exc:  # noqa: BLE001 - never break the scrape
+                self.logger.errorf("HBM metrics refresh failed: %s", exc)
         for hook in self._scrape_hooks.values():
             try:
                 hook()

@@ -74,8 +74,13 @@ class Counter(_Instrument):
             self.series[key] = float(self.series.get(key, 0.0)) + value  # type: ignore[arg-type]
 
     def expose(self) -> List[str]:
+        # snapshot under the lock: a hot-loop add() inserting a NEW label
+        # key during a scrape would otherwise mutate the dict mid-iteration
+        # and 500 the /metrics endpoint
+        with self.lock:
+            series = list(self.series.items())
         out = self._header()
-        for key, val in sorted(self.series.items()):
+        for key, val in sorted(series):
             out.append(f"{self.name}{_fmt_labels(key)} {val}")
         return out
 
@@ -92,8 +97,10 @@ class Gauge(_Instrument):
             self.series[_label_key(labels)] = float(value)
 
     def expose(self) -> List[str]:
+        with self.lock:   # see Counter.expose
+            series = list(self.series.items())
         out = self._header()
-        for key, val in sorted(self.series.items()):
+        for key, val in sorted(series):
             out.append(f"{self.name}{_fmt_labels(key)} {val}")
         return out
 
@@ -132,22 +139,37 @@ class Histogram(_Instrument):
             entry["count"] += n  # type: ignore[operator]
 
     def percentile(self, q: float, **labels: str) -> float:
-        """Approximate percentile from bucket midpoints (for tests/health, not SLO math)."""
+        """Approximate percentile from bucket MIDPOINTS (for tests/health,
+        not SLO math): the percentile falls in bucket i, and the estimate
+        is the midpoint of that bucket's (lower, upper] range — lower is 0
+        for the first bucket. Observations past the last bound clamp to the
+        last bound (the overflow bucket has no upper edge to average)."""
         key = _label_key(labels)
-        entry = self.series.get(key)
-        if not entry:
-            return math.nan
-        target = q * entry["count"]  # type: ignore[index]
+        with self.lock:
+            entry = self.series.get(key)
+            if not entry:
+                return math.nan
+            target = q * entry["count"]  # type: ignore[index]
+            counts = list(entry["counts"])  # type: ignore[index]
         cum = 0
-        for i, c in enumerate(entry["counts"]):  # type: ignore[index]
+        for i, c in enumerate(counts):
             cum += c
             if cum >= target:
-                return self.buckets[i] if i < len(self.buckets) else self.buckets[-1]
+                if i >= len(self.buckets):
+                    return self.buckets[-1]
+                lower = self.buckets[i - 1] if i > 0 else 0.0
+                return (lower + self.buckets[i]) / 2.0
         return self.buckets[-1]
 
     def expose(self) -> List[str]:
+        with self.lock:   # see Counter.expose — counts lists mutate in
+            # place under record_n, so each entry is deep-copied here
+            series = [(key, {"counts": list(entry["counts"]),  # type: ignore[index]
+                             "sum": entry["sum"],              # type: ignore[index]
+                             "count": entry["count"]})         # type: ignore[index]
+                      for key, entry in self.series.items()]
         out = self._header()
-        for key, entry in sorted(self.series.items()):
+        for key, entry in sorted(series):
             cum = 0
             for i, bound in enumerate(self.buckets):
                 cum += entry["counts"][i]  # type: ignore[index]

@@ -61,6 +61,15 @@ class CapacityPlan:
                 f"fits={self.fits} clamped={self.clamped}")
 
 
+def kv_token_bytes(cfg, dtype: Optional[str] = None) -> int:
+    """HBM bytes one cached token occupies across BOTH (k, v) caches:
+    2 * n_layers * n_kv_heads * head_dim * itemsize. The per-token unit the
+    capacity plan and the utilization ledger's bandwidth model share."""
+    return (2 * cfg.n_layers * cfg.n_kv_heads * cfg.head_dim
+            * _dtype_bytes(dtype or getattr(cfg, "kv_dtype", None)
+                           or cfg.dtype))
+
+
 def kv_cache_bytes(cfg, n_slots: int, seq_len: int,
                    dtype: Optional[str] = None) -> int:
     """Both (k, v) caches: 2 * [L, B, Hkv, dh, S] in the cache dtype.
@@ -68,9 +77,7 @@ def kv_cache_bytes(cfg, n_slots: int, seq_len: int,
     Exact HBM bytes: the S-minor layout is tile-aligned on TPU (no padding
     expansion — see init_kv_cache), so element count × itemsize is the
     physical footprint."""
-    per = (cfg.n_layers * n_slots * seq_len * cfg.n_kv_heads * cfg.head_dim
-           * _dtype_bytes(dtype or cfg.dtype))
-    return 2 * per
+    return n_slots * seq_len * kv_token_bytes(cfg, dtype=dtype or cfg.dtype)
 
 
 def params_bytes(cfg) -> int:

@@ -144,6 +144,21 @@ class TPUClient:
             ("app_tpu_slo_tpot_goodput",
              "fraction of recent requests meeting the TPOT target "
              "(flight recorder rolling window)"),
+            # utilization ledger (tpu/utilization.py): roofline telemetry
+            ("app_tpu_device_duty_cycle",
+             "fraction of the rolling window the device spent executing "
+             "dispatched programs"),
+            ("app_tpu_host_overhead_seconds",
+             "host/scheduler seconds (admission, prep, demux) in the "
+             "rolling utilization window"),
+            ("app_tpu_mfu",
+             "model FLOPs utilization vs the platform peak, by phase"),
+            ("app_tpu_mbu",
+             "HBM bandwidth utilization vs the platform peak, by phase"),
+            ("app_tpu_hbm_bytes",
+             "HBM bytes per device (kind=in_use|limit)"),
+            ("app_tpu_kv_pool_pages",
+             "KV page-pool occupancy (kind=used|free)"),
         ):
             try:
                 m.new_gauge(name, desc)
@@ -218,10 +233,17 @@ class TPUClient:
         if self.metrics is None:
             return
         for s in self.memory_stats():
+            dev = str(s["id"])
             self.metrics.set_gauge("app_tpu_hbm_bytes_used", s["bytes_in_use"],
-                                   device=str(s["id"]))
+                                   device=dev)
             self.metrics.set_gauge("app_tpu_hbm_bytes_limit", s["bytes_limit"],
-                                   device=str(s["id"]))
+                                   device=dev)
+            # canonical kind-labeled series (the legacy _used/_limit pair
+            # stays for dashboards built on PR 0; see docs/observability.md)
+            self.metrics.set_gauge("app_tpu_hbm_bytes", s["bytes_in_use"],
+                                   device=dev, kind="in_use")
+            self.metrics.set_gauge("app_tpu_hbm_bytes", s["bytes_limit"],
+                                   device=dev, kind="limit")
 
     # -- health (feeds /.well-known/health) -----------------------------------
     # the device round-trip gets this long before the probe is declared

@@ -47,10 +47,11 @@ from typing import Any, Dict, Iterator, List, Optional, Sequence, Set, Tuple
 from ..models.llama import (LlamaConfig, init_kv_cache_layers,
                             init_kv_scale_layers, llama_decode_step_unrolled,
                             llama_decode_step_unrolled_q8, llama_prefill_chunk,
-                            llama_prefill_last)
+                            llama_prefill_last, params_nbytes)
 from .executor import Executor, next_bucket
 from .obs import MetricsHook
 from .sampling import pack_controls, sample_tokens, temperature_of
+from .utilization import UtilizationLedger
 
 
 class CacheLostError(RuntimeError):
@@ -515,6 +516,13 @@ class LLMEngine:
         self._state_lock = threading.Lock()
         self._jnp = jnp
         self._obs = MetricsHook(self.metrics)
+        # utilization ledger (tpu/utilization.py): always-on roofline
+        # accounting — pure host arithmetic, O(1) per dispatch sync, fed
+        # from _sync_oldest and the loop's host-time stamps
+        self.util = UtilizationLedger(
+            cfg, metrics=self.metrics,
+            n_devices=mesh.size if mesh is not None else 1,
+            params_nbytes=params_nbytes(self.params))
         self.tracer = tracer
         # per-request flight recorder (tpu/flightrecorder.py): best-effort
         # like MetricsHook — every hook below is None-guarded and O(1), so
@@ -1550,6 +1558,7 @@ class LLMEngine:
         while not self._stop.is_set():
             self._last_step_at = time.monotonic()
             try:
+                host_t0 = time.time()
                 with self._state_lock:
                     self._admit()
                     # one chunk per iteration: decode dispatches below and
@@ -1581,6 +1590,12 @@ class LLMEngine:
                                         self._spec_accept_ema,
                                         self.SPEC_PROBE_EMA)
                                     break
+                # scheduler/prep/enqueue time this iteration (the state-lock
+                # block never blocks on the device — syncs happen below).
+                # Sub-millisecond idle iterations are noise, not overhead
+                host_s = time.time() - host_t0
+                if host_s >= 1e-3:
+                    self.util.note_host(host_s)
                 if self._inflight:
                     self._sync_oldest()
                 elif not self._chunk_jobs:
@@ -1847,7 +1862,10 @@ class LLMEngine:
                 self.recorder.record_admitted(request, slots_idx[row],
                                               bucket, batch_id=batch_id)
             admitted.append((slots_idx[row], request))
-        self._inflight.append(("prefill", first, admitted, dspan))
+        # the trailing timestamp is the dispatch-enqueue time the
+        # utilization ledger unions into the device-busy window at sync
+        self._inflight.append(("prefill", first, admitted, dspan,
+                               time.time()))
 
     def _dispatch_prefill(self, bucket: int,
                           slots_idx: List[int],
@@ -1943,7 +1961,8 @@ class LLMEngine:
 
         entry = self._inflight.popleft()
         if entry[0] == "prefill":
-            _, first, admitted, dspan = entry
+            _, first, admitted, dspan, dispatched_at = entry
+            sync_t0 = time.time()
             try:
                 first_host = np.asarray(first)  # blocks until the device got there
             except Exception as exc:
@@ -1954,6 +1973,10 @@ class LLMEngine:
             if dspan is not None:
                 dspan.end()
             now = time.time()
+            self.util.record_prefill(
+                tokens=sum(len(r.prompt_tokens) for _, r in admitted),
+                dispatched_at=dispatched_at, synced_at=now,
+                sync_wait_s=now - sync_t0)
             for row, (slot_idx, request) in enumerate(admitted):
                 slot = self.slots[slot_idx]
                 if slot.request is not request:  # cancelled between dispatch+sync
@@ -1974,6 +1997,7 @@ class LLMEngine:
         if entry[0] == "verify":
             _, fut, snapshot, d, started, dspan = entry
             out_dev, n_emit_dev = fut
+            sync_t0 = time.time()
             try:
                 out_host = np.asarray(out_dev)             # [B, d+1]
                 n_emit_host = np.asarray(n_emit_dev)       # [B]
@@ -1984,7 +2008,16 @@ class LLMEngine:
                 raise CacheLostError(f"verify execution failed: {exc}") from exc
             if dspan is not None:
                 dspan.end()
-            elapsed = time.time() - started
+            synced = time.time()
+            elapsed = synced - started
+            # a verify scores d+1 positions per row; slot lengths are read
+            # BEFORE the demux advances them, i.e. the dispatched context
+            self.util.record_decode(
+                rows=len(snapshot), steps=d + 1,
+                kv_tokens=sum(self.slots[i].length for i, r, _ in snapshot
+                              if self.slots[i].request is r),
+                dispatched_at=started, synced_at=synced,
+                sync_wait_s=synced - sync_t0)
             self._obs.hist("app_tpu_execute_seconds", elapsed)
             emitted = n_active = n_eligible = device_accepted = 0
             for slot_idx, request, eligible in snapshot:
@@ -2046,6 +2079,7 @@ class LLMEngine:
             return
 
         _, out_tokens, snapshot, block, started, dspan = entry
+        sync_t0 = time.time()
         try:
             tokens_host = np.asarray(out_tokens)  # [B, block]; device sync point
         except Exception as exc:
@@ -2055,8 +2089,17 @@ class LLMEngine:
             raise CacheLostError(f"decode execution failed: {exc}") from exc
         if dspan is not None:
             dspan.end()
-        step_s = (time.time() - started) / block
-        self._obs.hist("app_tpu_execute_seconds", time.time() - started)
+        synced = time.time()
+        step_s = (synced - started) / block
+        self._obs.hist("app_tpu_execute_seconds", synced - started)
+        # slot lengths are pre-demux here: the live context this dispatch
+        # actually read each step (the MBU KV term)
+        self.util.record_decode(
+            rows=len(snapshot), steps=block,
+            kv_tokens=sum(self.slots[i].length for i, r in snapshot
+                          if self.slots[i].request is r),
+            dispatched_at=started, synced_at=synced,
+            sync_wait_s=synced - sync_t0)
 
         n_active = 0
         emitted = 0

@@ -63,6 +63,11 @@ class CompiledProgram:
         self.name = name
         self.key = key
         self.executions = 0
+        # compile-table bookkeeping (Executor.compile_table): how this
+        # program came to exist, what it cost, how often the cache served it
+        self.compile_seconds = 0.0
+        self.hits = 0
+        self.source = "compiled"        # "compiled" | "disk"
 
     def __call__(self, *args):
         self.executions += 1
@@ -241,7 +246,9 @@ class Executor:
             pass
         if self.logger is not None:
             self.logger.infof("loaded %s from program cache", name)
-        return CompiledProgram(compiled, name, key)
+        program = CompiledProgram(compiled, name, key)
+        program.source = "disk"
+        return program
 
     @staticmethod
     def _device_order(compiled):
@@ -324,6 +331,7 @@ class Executor:
         with self._lock:
             cached = self._cache.get(key)
         if cached is not None:
+            cached.hits += 1
             self._observe_compile(name, 0.0, hit=True)
             return cached
 
@@ -347,6 +355,7 @@ class Executor:
         compiled = jitted.lower(*args).compile()
         program = CompiledProgram(compiled, name, key)
         elapsed = time.time() - start
+        program.compile_seconds = elapsed
         self._save_to_disk(key, fn, compiled, dev_sig)
         with self._lock:
             # a racing thread may have compiled the same key; keep the first
@@ -378,3 +387,38 @@ class Executor:
     def cache_info(self) -> Dict[str, int]:
         with self._lock:
             return {prog.name: prog.executions for prog in self._cache.values()}
+
+    def compile_table(self) -> Dict[str, Any]:
+        """The compile cache as an operator table (/debug/engine): one row
+        per program NAME (shape/K variants of the same program aggregate,
+        with a `variants` count), plus cache-wide totals. The hit ratio is
+        in-memory hits over all compile() lookups — disk loads count as
+        misses for the in-memory cache but are reported separately."""
+        with self._lock:
+            programs = list(self._cache.values())
+        by_name: Dict[str, Dict[str, Any]] = {}
+        for prog in programs:
+            row = by_name.setdefault(prog.name, {
+                "name": prog.name, "variants": 0, "executions": 0,
+                "cache_hits": 0, "compile_seconds": 0.0,
+                "disk_loads": 0})
+            row["variants"] += 1
+            row["executions"] += prog.executions
+            row["cache_hits"] += prog.hits
+            row["compile_seconds"] += prog.compile_seconds
+            row["disk_loads"] += 1 if prog.source == "disk" else 0
+        rows = sorted(by_name.values(),
+                      key=lambda r: (-r["compile_seconds"], r["name"]))
+        for row in rows:
+            row["compile_seconds"] = round(row["compile_seconds"], 3)
+        hits = sum(r["cache_hits"] for r in rows)
+        lookups = hits + len(programs)
+        return {
+            "programs": rows,
+            "distinct_programs": len(programs),
+            "compile_seconds_total": round(
+                sum(p.compile_seconds for p in programs), 3),
+            "cache_hits_total": hits,
+            "disk_hits_total": self.disk_hits,
+            "hit_ratio": round(hits / lookups, 4) if lookups else 0.0,
+        }

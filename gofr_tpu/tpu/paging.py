@@ -315,6 +315,10 @@ class PagedLLMEngine(LLMEngine):
             slot.pages = None
         super()._finish_slot(slot)
         self._obs.gauge("app_tpu_pages_used", self.allocator.used_pages)
+        self._obs.gauge("app_tpu_kv_pool_pages", self.allocator.used_pages,
+                        kind="used")
+        self._obs.gauge("app_tpu_kv_pool_pages", self.allocator.free_pages,
+                        kind="free")
 
     # -- programs -------------------------------------------------------------
     def warmup(self, grow: bool = True, k_variants: bool = False) -> None:

@@ -8,34 +8,62 @@ dispatches during the window: prefill/decode HLOs, DMA, scalar-core stalls.
 
 Wire-up: ``app.enable_profiler()`` adds
 
-    POST /debug/profile {"seconds": 2, "dir": "./profiles"}  -> capture, 201
+    POST /debug/profile {"seconds": 2, "dir": "./profiles"}  -> 202, the
+         capture runs on a daemon thread (an HTTP worker must never be
+         pinned for the full window — up to 60 s — nor trip the handler's
+         request timeout); the response carries the pending ``trace_dir``
     GET  /debug/profile                                      -> status
+         (poll until ``active`` is false; ``last_dir`` is the completed
+         capture, ``last_error`` a failed one)
 
-Captures are serialized (one at a time) and bounded (<= 60 s) so a stray
-request cannot pin the trace buffer forever.
+Captures are serialized (one at a time, 409 while one runs) and bounded
+(<= 60 s) so a stray request cannot pin the trace buffer forever. All
+``_state`` reads and writes hold ``_lock`` — status polls race the capture
+thread by design.
 """
 
 from __future__ import annotations
 
+import json
 import os
 import threading
 import time
-from typing import Optional
+from typing import Optional, Tuple
 
 _MAX_SECONDS = 60.0
 
 _lock = threading.Lock()
-_state = {"active": False, "last_dir": None, "last_captured_at": None}
+_state = {"active": False, "pending_dir": None, "started_at": None,
+          "last_dir": None, "last_captured_at": None, "last_error": None}
 
 
-def capture_trace(seconds: float, log_dir: str = "./profiles") -> str:
-    """Capture `seconds` of device+host activity into a timestamped subdir.
-
-    Blocks for the duration. Raises RuntimeError if a capture is already
-    running (the profiler is a global singleton in the process).
-    """
+def _run_capture(seconds: float, out: str) -> None:
+    """The capture body, on the dedicated daemon thread."""
     import jax
 
+    error: Optional[str] = None
+    try:
+        jax.profiler.start_trace(out)
+        try:
+            time.sleep(seconds)
+        finally:
+            jax.profiler.stop_trace()
+    except Exception as exc:  # noqa: BLE001 - surfaced via status, not a crash
+        error = str(exc)
+    with _lock:
+        _state["active"] = False
+        _state["pending_dir"] = None
+        _state["last_error"] = error
+        if error is None:
+            _state["last_dir"] = out
+            _state["last_captured_at"] = time.time()
+
+
+def start_capture(seconds: float, log_dir: str = "./profiles") -> Tuple[str, float]:
+    """Begin an async capture; returns (trace_dir, bounded_seconds).
+
+    Raises ValueError on a bad duration and RuntimeError while another
+    capture runs (the profiler is a global singleton in the process)."""
     seconds = min(float(seconds), _MAX_SECONDS)
     if seconds <= 0:
         raise ValueError("profile duration must be positive")
@@ -44,32 +72,65 @@ def capture_trace(seconds: float, log_dir: str = "./profiles") -> str:
         if _state["active"]:
             raise RuntimeError("a profile capture is already running")
         _state["active"] = True
+        _state["pending_dir"] = out
+        _state["started_at"] = time.time()
+        _state["last_error"] = None
     try:
         os.makedirs(out, exist_ok=True)
-        jax.profiler.start_trace(out)
-        time.sleep(seconds)
-        jax.profiler.stop_trace()
-        _state["last_dir"] = out
-        _state["last_captured_at"] = time.time()
-        return out
-    finally:
-        _state["active"] = False
+    except OSError:
+        with _lock:
+            _state["active"] = False
+            _state["pending_dir"] = None
+        raise
+    threading.Thread(target=_run_capture, args=(seconds, out),
+                     name="xprof-capture", daemon=True).start()
+    return out, seconds
+
+
+def capture_trace(seconds: float, log_dir: str = "./profiles",
+                  poll_s: float = 0.05) -> str:
+    """Blocking convenience wrapper around start_capture (scripts/tools):
+    waits for the capture to finish and returns its trace dir."""
+    out, bounded = start_capture(seconds, log_dir)
+    deadline = time.time() + bounded + 30.0
+    while time.time() < deadline:
+        with _lock:
+            if not _state["active"]:
+                if _state["last_error"]:
+                    raise RuntimeError(_state["last_error"])
+                return out
+        time.sleep(poll_s)
+    raise TimeoutError(f"profile capture did not finish within {bounded + 30:.0f}s")
 
 
 def status() -> dict:
-    return dict(_state)
+    with _lock:
+        return dict(_state)
 
 
 def install_routes(app, path: str = "/debug/profile") -> None:
     """Register the capture/status endpoints on a gofr_tpu App."""
+    from ..http.responder import Response
 
     @app.post(path)
     def profile(ctx):  # noqa: ANN001
         body = ctx.bind() or {}
         seconds = float(body.get("seconds", 2.0))
         log_dir = str(body.get("dir", "./profiles"))
-        trace_dir = capture_trace(seconds, log_dir)
-        return {"trace_dir": trace_dir, "seconds": min(seconds, _MAX_SECONDS)}
+        try:
+            trace_dir, bounded = start_capture(seconds, log_dir)
+        except RuntimeError as exc:
+            return Response(status=409,
+                            headers={"Content-Type": "application/json"},
+                            body=json.dumps({"error": {
+                                "message": str(exc)}}).encode())
+        # 202: accepted, capturing in the background — poll GET for
+        # completion (trace_dir is where the capture will land)
+        return Response(status=202,
+                        headers={"Content-Type": "application/json"},
+                        body=json.dumps({"data": {
+                            "trace_dir": trace_dir, "seconds": bounded,
+                            "status": "capturing"}}).encode())
 
     @app.get(path)
     def profile_status(ctx):  # noqa: ANN001

@@ -270,6 +270,10 @@ def test_mock_container_for_handler_unit_tests():
 
 
 def test_profiler_endpoint(tmp_path):
+    """POST answers 202 immediately (the capture runs on a daemon thread —
+    an HTTP worker is never pinned for the window); GET polls to done."""
+    import time as _time
+
     app = make_app()
     app.enable_profiler()
     app.start()
@@ -278,15 +282,25 @@ def test_profiler_endpoint(tmp_path):
         r = requests.get(f"{base}/debug/profile")
         assert r.status_code == 200
         assert r.json()["data"]["active"] is False
+        t0 = _time.time()
         r = requests.post(f"{base}/debug/profile",
-                          json={"seconds": 0.2, "dir": str(tmp_path)})
-        assert r.status_code == 201
+                          json={"seconds": 1.0, "dir": str(tmp_path)})
+        assert r.status_code == 202
+        assert _time.time() - t0 < 1.0  # did NOT block for the capture
         trace_dir = r.json()["data"]["trace_dir"]
         assert trace_dir.startswith(str(tmp_path))
         import os
 
-        assert os.path.isdir(trace_dir)  # xplane capture landed
-        status = requests.get(f"{base}/debug/profile").json()["data"]
-        assert status["last_dir"] == trace_dir
+        assert os.path.isdir(trace_dir)  # pending dir created up front
+        deadline = _time.time() + 30
+        while _time.time() < deadline:
+            status = requests.get(f"{base}/debug/profile").json()["data"]
+            if not status["active"]:
+                break
+            assert status["pending_dir"] == trace_dir
+            _time.sleep(0.05)
+        assert status["active"] is False
+        assert status["last_error"] is None
+        assert status["last_dir"] == trace_dir  # xplane capture landed
     finally:
         app.shutdown()

@@ -62,11 +62,64 @@ def test_histogram_buckets_and_summary():
     assert "lat_sum 55.55" in text
 
 
-def test_histogram_percentile():
+def test_histogram_percentile_midpoints():
     m = Manager()
     m.new_histogram("p", "", buckets=(1, 2, 4, 8))
     for v in (0.5, 1.5, 3, 7):
         m.record_histogram("p", v)
     hist = m.get("p")
-    assert hist.percentile(0.5) in (1, 2)
+    # midpoint semantics: the first bucket's lower edge is 0
+    assert hist.percentile(0.25) == 0.5   # bucket (0, 1]
+    assert hist.percentile(0.5) == 1.5    # bucket (1, 2]
+    assert hist.percentile(1.0) == 6.0    # bucket (4, 8]
+    # overflow observations clamp to the last finite bound
+    m.record_histogram("p", 50.0)
     assert hist.percentile(1.0) == 8
+
+
+def test_exposition_is_safe_under_concurrent_label_churn():
+    """Scrape-while-recording stress: hot-loop add()/record_n() inserting
+    NEW label keys while /metrics renders must never raise
+    'dictionary changed size during iteration' (the exposition snapshots
+    each instrument's series under its lock)."""
+    import threading
+
+    m = Manager()
+    m.new_counter("churn_total", "")
+    m.new_gauge("churn_gauge", "")
+    m.new_histogram("churn_hist", "", buckets=(0.1, 1.0))
+    import time
+
+    stop = threading.Event()
+    record_errors = []
+
+    def recorder(tag):
+        i = 0
+        while not stop.is_set():
+            i += 1
+            key = f"{tag}-{i}"   # every iteration inserts a NEW label key
+            try:
+                m.increment_counter("churn_total", 1, worker=key)
+                m.set_gauge("churn_gauge", i, worker=key)
+                m.record_histogram_n("churn_hist", 0.5, 3, worker=key)
+            except Exception as exc:  # noqa: BLE001 - the bug under test
+                record_errors.append(exc)
+                return
+
+    threads = [threading.Thread(target=recorder, args=(t,))
+               for t in range(4)]
+    for t in threads:
+        t.start()
+    scrapes = 0
+    try:
+        deadline = time.time() + 2.0   # time-bounded: cardinality grows
+        while time.time() < deadline:  # fast, so a count loop would drag
+            text = m.expose()   # raises RuntimeError without the snapshot
+            assert "churn_total" in text
+            scrapes += 1
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+    assert scrapes > 0
+    assert not record_errors

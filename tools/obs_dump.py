@@ -8,6 +8,11 @@ goodput fractions, and engine events (cache growth, resets, sheds) —
 so a blown-tail soak can be diagnosed after the fact instead of
 re-reproduced.
 
+Each line also carries the fleet-level `/debug/engine` snapshot (slots,
+page pool, utilization window — MFU/MBU/duty-cycle — and compile-cache
+totals), so soak artifacts gain an efficiency axis next to the tail
+evidence.
+
 Usage:
     python tools/obs_dump.py [--server http://127.0.0.1:8000]
                              [--metrics http://127.0.0.1:2121]
@@ -29,7 +34,8 @@ import urllib.request
 
 SLO_GAUGES = ("app_tpu_slo_ttft_goodput", "app_tpu_slo_tpot_goodput",
               "app_tpu_tokens_per_second", "app_tpu_engine_stall_seconds",
-              "app_tpu_active_slots", "app_tpu_queue_depth")
+              "app_tpu_active_slots", "app_tpu_queue_depth",
+              "app_tpu_device_duty_cycle", "app_tpu_host_overhead_seconds")
 
 
 def _get(url: str, timeout: float = 5.0) -> str:
@@ -62,6 +68,20 @@ def poll_once(server: str, metrics_base: str) -> dict:
         entry["finished_total"] = flight.get("finished_total")
     except Exception as exc:  # noqa: BLE001 - keep polling through restarts
         entry["flight_error"] = str(exc)
+    try:
+        body = json.loads(_get(server.rstrip("/") + "/debug/engine"))
+        snap = body.get("data", body)
+        engine = {"engine": snap.get("engine"),
+                  "utilization": snap.get("utilization"),
+                  "page_pool": snap.get("page_pool")}
+        compile_table = snap.get("compile") or {}
+        # totals only — the per-program rows would bloat the JSONL stream
+        engine["compile"] = {k: compile_table.get(k) for k in (
+            "distinct_programs", "compile_seconds_total",
+            "cache_hits_total", "disk_hits_total", "hit_ratio")}
+        entry["engine"] = engine
+    except Exception as exc:  # noqa: BLE001 - older servers lack the route
+        entry["engine_error"] = str(exc)
     try:
         entry["gauges"] = scrape_gauges(metrics_base)
     except Exception as exc:  # noqa: BLE001

@@ -158,6 +158,21 @@ def run_profile(profile: str, seconds: float, n_threads: int,
     snap = recorder.snapshot()
     stats["slo"] = snap["slo"]
     stats["engine_events"] = snap["engine_events"]
+    # efficiency axis (tpu/utilization.py): final MFU/MBU/duty-cycle so
+    # BENCH_*.json judges throughput AGAINST the hardware roofline, not
+    # just in absolute tokens/sec
+    util = getattr(engine, "util", None)
+    if util is not None:
+        u = util.window_stats()
+        stats["utilization"] = {
+            "duty_cycle": u["duty_cycle"],
+            "host_overhead_s": u["host_overhead_s"],
+            "sync_wait_s": u["sync_wait_s"],
+            "mfu": {k: round(v, 6) for k, v in u["mfu"].items()},
+            "mbu": {k: round(v, 6) for k, v in u["mbu"].items()},
+            "dispatches": u["dispatches"],
+            "peak_source": u["peak_source"],
+        }
     # the 5 slowest-TTFT completions, full phase breakdown each
     with_ttft = [r for r in snap["recent"] if "ttft_s" in r]
     stats["slowest_ttft"] = sorted(with_ttft, key=lambda r: -r["ttft_s"])[:5]
