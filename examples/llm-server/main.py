@@ -24,7 +24,7 @@ from gofr_tpu.tpu.device import pin_platform_from_env  # noqa: E402
 pin_platform_from_env()
 
 from gofr_tpu import App, Stream  # noqa: E402
-from gofr_tpu.http.errors import InvalidParam  # noqa: E402
+from gofr_tpu.http.errors import InvalidParam, ServiceUnavailable  # noqa: E402
 from gofr_tpu.models.llama import LlamaConfig, llama_init  # noqa: E402
 from gofr_tpu.models.tokenizer import ByteTokenizer, StreamingDecoder  # noqa: E402
 from gofr_tpu.tpu.device import TPUClient  # noqa: E402
@@ -54,6 +54,20 @@ def _load_tokenizer(path: str):
     if "model" in head and "vocab" in head.get("model", {}):
         return ByteLevelBPETokenizer.from_tokenizer_json(path, data=head)
     return BPETokenizer.from_file(path)
+
+
+def _raise_for_shed(exc: BaseException) -> None:
+    """Engine shed errors — anything carrying a duck-typed 503 status_code
+    (EngineDrainingError, EngineStalledError, breaker-open DeviceLostError)
+    — re-raise as the transport's ServiceUnavailable with a Retry-After
+    hint, so load balancers and SDK retry policies treat them as
+    retryable instead of a bare 500. Everything else passes through."""
+    if getattr(exc, "status_code", None) == 503:
+        raise ServiceUnavailable(
+            str(exc),
+            retry_after_s=getattr(exc, "retry_after_s", None) or 1.0
+        ) from exc
+    raise exc
 
 
 def _register_engine_observability(app: App, engine) -> None:
@@ -198,6 +212,14 @@ def build_engine(app: App, default_sampling_controls: bool = False) -> LLMEngine
         # OpenAI server defaults it ON (it must honor client top_p)
         sampling_controls=app.config.get_bool("SAMPLING_CONTROLS",
                                               default_sampling_controls),
+        # crash-only recovery: replay interrupted requests after a device
+        # reset (bounded per request), and open the reset-storm breaker
+        # (503 DeviceLostError + health DOWN) when resets cluster
+        retry_budget=app.config.get_int("ENGINE_RETRY_BUDGET", 2),
+        reset_storm_max=app.config.get_int("RESET_STORM_MAX", 3),
+        reset_storm_window_s=app.config.get_float("RESET_STORM_WINDOW_S",
+                                                  60.0),
+        breaker_cooldown_s=app.config.get_float("BREAKER_COOLDOWN_S", 5.0),
         **paged_kw,
     )
     engine.tokenizer = tokenizer
@@ -314,6 +336,10 @@ def build_app(config=None, engine=None) -> App:
     # false opts out
     if app.config.get_bool("ENGINE_SNAPSHOT", True):
         app.enable_engine_snapshot(engine)
+    # chaos plane: POST /debug/faults + engine/executor/device fault hooks.
+    # HARD-gated on FAULT_INJECTION=true — disabled (the default) keeps the
+    # zero-overhead faults=None fast path and the endpoint 404s
+    app.enable_fault_injection(engine)
     tokenizer: ByteTokenizer = engine.tokenizer
     # token streaming over gRPC rides the same engine (GRPC_PORT)
     app.register_grpc_service(build_generate_service(engine, tokenizer))
@@ -350,6 +376,8 @@ def build_app(config=None, engine=None) -> App:
                 top_k=top_k)
         except ValueError as exc:
             raise InvalidParam([str(exc)]) from exc
+        except Exception as exc:  # noqa: BLE001 - sheds → 503 + Retry-After
+            _raise_for_shed(exc)
 
         if not stream:
             from gofr_tpu.http.errors import RequestTimeout

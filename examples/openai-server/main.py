@@ -51,7 +51,11 @@ def _load_llm_server():
     return module
 
 
-build_engine = _load_llm_server().build_engine
+_llm_server = _load_llm_server()
+build_engine = _llm_server.build_engine
+# engine 503 sheds (draining / stalled / breaker-open DeviceLostError) →
+# ServiceUnavailable + Retry-After, shared with the native surface
+_raise_for_shed = _llm_server._raise_for_shed
 
 
 def _render_chat(messages) -> str:
@@ -77,6 +81,8 @@ def build_app(**kw) -> App:
     # parity; ENGINE_SNAPSHOT=false opts out)
     if app.config.get_bool("ENGINE_SNAPSHOT", True):
         app.enable_engine_snapshot(engine)
+    # chaos plane (llm-server parity): 404s unless FAULT_INJECTION=true
+    app.enable_fault_injection(engine)
     tokenizer = engine.tokenizer
     model_id = app.config.get_or_default("MODEL_PRESET", "debug")
 
@@ -154,13 +160,19 @@ def build_app(**kw) -> App:
         # ctx threads the caller's trace context through to the engine so
         # the flight recorder's engine child spans (queue/prefill/decode)
         # share the inbound trace id
-        return engine.submit(prompt_tokens, max_new_tokens=max_tokens,
-                             temperature=temperature,
-                             stop_tokens={tokenizer.EOS},
-                             span=ctx.span if ctx is not None else None,
-                             traceparent=(ctx.request.traceparent
-                                          if ctx is not None else None),
-                             min_tokens=min_tokens, top_p=top_p, top_k=top_k)
+        try:
+            return engine.submit(prompt_tokens, max_new_tokens=max_tokens,
+                                 temperature=temperature,
+                                 stop_tokens={tokenizer.EOS},
+                                 span=ctx.span if ctx is not None else None,
+                                 traceparent=(ctx.request.traceparent
+                                              if ctx is not None else None),
+                                 min_tokens=min_tokens, top_p=top_p,
+                                 top_k=top_k)
+        except ValueError:
+            raise
+        except Exception as exc:  # noqa: BLE001 - sheds → 503 + Retry-After
+            _raise_for_shed(exc)
 
     def _finish_reason(n_emitted: int, max_tokens: int) -> str:
         return "length" if n_emitted >= max_tokens else "stop"

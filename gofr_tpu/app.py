@@ -281,6 +281,35 @@ class App:
         install_routes(self, recorder, path)
         return recorder
 
+    def enable_fault_injection(self, engine, path: str = "/debug/faults"):
+        """Arm the chaos plane (tpu/faults.py) on an engine and expose the
+        POST/GET /debug/faults drill endpoints — HARD-gated on
+        FAULT_INJECTION=true in config. When disabled (the default) this
+        returns None, registers NO route (the endpoint 404s), and the
+        engine/executor/device keep their zero-overhead ``faults=None``
+        fast path.
+
+        Config: FAULT_INJECTION (master switch), FAULT_INJECTION_PLAN
+        (inline JSON fault schedule or ``@/path/to/plan.json``),
+        FAULT_INJECTION_SEED (deterministic trigger RNG). Returns the
+        FaultPlane when enabled."""
+        from .tpu.faults import install_routes, plane_from_config
+
+        plane = plane_from_config(self.config, logger=self.logger)
+        if plane is None:
+            return None
+        engine.faults = plane
+        executor = getattr(engine, "executor", None)
+        if executor is not None:
+            executor.faults = plane
+        if self.container.tpu is not None:
+            self.container.tpu.faults = plane
+        install_routes(self, plane, path)
+        self.logger.warnf(
+            "FAULT INJECTION ENABLED: chaos plane armed on the engine, "
+            "executor, and device; POST %s drives drills", path)
+        return plane
+
     def enable_engine_snapshot(self, engine, path: str = "/debug/engine"):
         """Expose the engine's fleet-level operator surface
         (tpu/utilization.py): GET /debug/engine — one JSON snapshot of

@@ -13,6 +13,10 @@ from typing import Sequence
 
 class HTTPError(Exception):
     status_code = 500
+    # when set (seconds), the Responder adds a Retry-After header — the
+    # hint that turns a 503 into an actionable backoff for SDK retry
+    # policies instead of a dead end
+    retry_after_s: float | None = None
 
     def __init__(self, message: str = "", status_code: int | None = None):
         super().__init__(message or self.__class__.__name__)
@@ -75,8 +79,11 @@ class PanicRecovery(HTTPError):
 class ServiceUnavailable(HTTPError):
     status_code = 503
 
-    def __init__(self, message: str = "service unavailable"):
+    def __init__(self, message: str = "service unavailable",
+                 retry_after_s: float | None = None):
         super().__init__(message)
+        if retry_after_s is not None:
+            self.retry_after_s = retry_after_s
 
 
 def status_from_error(err: BaseException, method: str) -> int:

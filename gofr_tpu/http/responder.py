@@ -98,7 +98,17 @@ class Responder:
             if not isinstance(status, int):
                 status = err.status_code if isinstance(err, HTTPError) else 500
             payload = {"error": {"message": getattr(err, "message", None) or str(err)}}
-            return self._json(status, payload)
+            response = self._json(status, payload)
+            # duck-typed retry_after_s (engine sheds: draining, stalled,
+            # breaker-open DeviceLostError) becomes the Retry-After header
+            # RFC-compliant clients and SDK retry policies act on
+            retry_after = getattr(err, "retry_after_s", None)
+            if isinstance(retry_after, (int, float)) and retry_after > 0:
+                import math
+
+                response.headers["Retry-After"] = str(
+                    max(1, int(math.ceil(retry_after))))
+            return response
 
         if isinstance(data, Response):
             return data

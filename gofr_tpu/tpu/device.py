@@ -68,6 +68,9 @@ class TPUClient:
         self._probe_lock = threading.Lock()
         self._probe_thread = None
         self._probe_result = None
+        # fault-injection plane (tpu/faults.py): None in production; armed
+        # deployments can wedge/fail the health probe for chaos drills
+        self.faults = None
 
     # -- provider pattern (mongo.go:142-155) ----------------------------------
     def use_logger(self, logger) -> None:
@@ -122,6 +125,15 @@ class TPUClient:
             ("app_tpu_spec_drafted_total", "speculative draft tokens proposed"),
             ("app_tpu_spec_accepted_total", "speculative draft tokens accepted"),
             ("app_tpu_page_waits_total", "admissions deferred on page-pool exhaustion"),
+            # crash-only recovery (tpu/faults.py + engine replay)
+            ("app_tpu_device_resets_total",
+             "device-state resets after a failed donated-cache program"),
+            ("app_tpu_request_replays_total",
+             "interrupted requests requeued for replay after a device reset"),
+            ("app_tpu_replayed_tokens_total",
+             "already-delivered tokens re-prefilled by replay admissions"),
+            ("app_tpu_requests_quarantined_total",
+             "poison requests failed after repeatedly reset-looping the engine"),
         ):
             try:
                 m.new_counter(name, desc)
@@ -159,6 +171,8 @@ class TPUClient:
              "HBM bytes per device (kind=in_use|limit)"),
             ("app_tpu_kv_pool_pages",
              "KV page-pool occupancy (kind=used|free)"),
+            ("app_tpu_breaker_state",
+             "reset-storm breaker state (0=closed, 1=half_open, 2=open)"),
         ):
             try:
                 m.new_gauge(name, desc)
@@ -259,6 +273,8 @@ class TPUClient:
         try:
             import jax.numpy as jnp
 
+            if self.faults is not None:  # chaos drills: wedge/fail the probe
+                self.faults.hit("device.health_probe")
             ok = float(jnp.asarray(1.0) + 1.0) == 2.0
             self._probe_result = (STATUS_UP if ok else STATUS_DEGRADED, None)
         except Exception as exc:  # noqa: BLE001

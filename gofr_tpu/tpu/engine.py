@@ -63,12 +63,31 @@ class CacheLostError(RuntimeError):
 class EngineDrainingError(RuntimeError):
     """Submitted while the engine drains for shutdown. status_code is
     duck-typed for the HTTP responder: 503 tells load balancers and SDK
-    retry policies to go elsewhere (a bare 500 would not be retried)."""
+    retry policies to go elsewhere (a bare 500 would not be retried).
+    retry_after_s rides along as the Retry-After hint: a draining backend
+    is gone for good, so clients should re-resolve immediately."""
 
     status_code = 503
+    retry_after_s = 1.0
 
     def __init__(self):
         super().__init__("engine draining: not accepting new requests")
+
+
+class DeviceLostError(RuntimeError):
+    """Submitted while the reset-storm breaker is open: the device has
+    reset repeatedly inside the storm window and the engine is refusing
+    work until a half-open probe proves it sane again. 503 duck-typed
+    like the other sheds; retry_after_s carries the breaker's remaining
+    cooldown so a well-behaved client backs off exactly that long."""
+
+    status_code = 503
+
+    def __init__(self, retry_after_s: float = 1.0):
+        self.retry_after_s = float(retry_after_s)
+        super().__init__(
+            f"device lost: reset-storm breaker open; retry in "
+            f"{self.retry_after_s:.1f}s or on another backend")
 
 
 class EngineStalledError(RuntimeError):
@@ -84,6 +103,7 @@ class EngineStalledError(RuntimeError):
     analog) while /health reports the engine DEGRADED with the stall age."""
 
     status_code = 503
+    retry_after_s = 15.0
 
     def __init__(self, stall_s: float):
         super().__init__(
@@ -137,6 +157,24 @@ class GenerationRequest:
         self.first_token_at: Optional[float] = None
         self.finished_at: Optional[float] = None
         self.generated = 0
+        # every token already DELIVERED to the client, in order — the
+        # replay ledger: after a device reset the request re-admits with
+        # prompt + emitted as its prefill window and the remaining budget,
+        # so the client's stream pauses instead of failing and no position
+        # is ever re-emitted or dropped. len(emitted) == generated always.
+        self.emitted: List[int] = []
+        # device-reset re-admissions consumed (bounded by the engine's
+        # retry_budget; crossing it fails the request instead)
+        self.replays = 0
+
+    @property
+    def resume_tokens(self) -> List[int]:
+        """The admission window: prompt + already-delivered tokens. For a
+        fresh request this is just the prompt; for a replay-after-reset
+        re-admission it is the full context the KV cache must rebuild."""
+        if not self.emitted:
+            return self.prompt_tokens
+        return self.prompt_tokens + self.emitted
 
     def cancel(self) -> None:
         self.cancelled.set()
@@ -315,6 +353,11 @@ class LLMEngine:
         sampling_controls: bool = False,
         admission_plane=None,
         flight_recorder=None,
+        retry_budget: int = 2,
+        reset_storm_max: int = 3,
+        reset_storm_window_s: float = 60.0,
+        breaker_cooldown_s: float = 5.0,
+        faults=None,
     ):
         """mesh: optional jax.sharding.Mesh with a "tp" axis. When given, the
         engine serves TENSOR-PARALLEL: params shard per serving_param_specs
@@ -528,6 +571,30 @@ class LLMEngine:
         # like MetricsHook — every hook below is None-guarded and O(1), so
         # serving without a recorder pays one attribute check per site
         self.recorder = flight_recorder
+        # fault-injection plane (tpu/faults.py): None in production — every
+        # hook site is one attribute check, the zero-overhead contract
+        self.faults = faults
+        # crash-only recovery: replay-after-reset budget + reset-storm
+        # breaker (tpu/faults.py). Active requests survive a device reset
+        # by re-admitting at prompt+emitted with elevated priority; the
+        # breaker sheds submits (503 DeviceLostError) once resets cluster
+        from .faults import ResetStormBreaker
+
+        self.retry_budget = max(0, int(retry_budget))
+        self.breaker = ResetStormBreaker(max_resets=reset_storm_max,
+                                         window_s=reset_storm_window_s,
+                                         cooldown_s=breaker_cooldown_s)
+        # poison tracking: (request id, consecutive resets) where that
+        # request was the SOLE work in flight — two in a row quarantines
+        # it rather than letting one bad request reset-loop the engine
+        self._sole_reset_id: Optional[int] = None
+        self._sole_reset_streak = 0
+        # recovery evidence counters (plain ints, loop-thread writes only):
+        # the soak/chaos artifacts read these even when metrics is None
+        self.resets_total = 0
+        self.replays_total = 0
+        self.replayed_tokens_total = 0
+        self.quarantined_total = 0
         self._batch_seq = itertools.count(1)
         # chunked prefill (opt-in, 0 = off): prompts in buckets larger than
         # this are admitted as several bounded chunk dispatches, so decode
@@ -658,6 +725,8 @@ class LLMEngine:
                     tuple(jnp.pad(s, spad) for s in vs_layers))
 
         try:
+            if self.faults is not None:
+                self.faults.hit("engine.cache_grow")
             if self._q8:
                 program = self.executor.compile(
                     f"kv-grow-q8-{self._cache_len}-to-{new_len}", grow_fn_q8,
@@ -736,6 +805,14 @@ class LLMEngine:
             "active_slots": sum(1 for s in self.slots if s.active),
             "queue_depth": self._pending.qsize(),
         }
+        if self.breaker.blocked():
+            # reset storm: DOWN, not DEGRADED — there is no in-flight work
+            # that could still complete (the resets failed or requeued it),
+            # and the half-open probe, not routed traffic, decides recovery
+            details["breaker"] = self.breaker.snapshot()
+            from ..container import STATUS_DOWN
+
+            return Health(status=STATUS_DOWN, details=details)
         stall = self._stall_over_threshold()
         if stall:
             details["stall_seconds"] = round(stall, 1)
@@ -766,6 +843,12 @@ class LLMEngine:
                 self.recorder.record_engine_event("stall_shed",
                                                   stall_s=round(stall, 1))
             raise EngineStalledError(stall)
+        retry_after = self.breaker.reject_for()
+        if retry_after is not None:
+            if self.recorder is not None:
+                self.recorder.record_engine_event(
+                    "breaker_shed", state=self.breaker.state)
+            raise DeviceLostError(retry_after)
         if self._plane is not None and not self._plane.is_leader:
             # multi-controller serving has ONE ingress: rank 0 composes
             # every admission wave; this rank only replays them
@@ -847,11 +930,30 @@ class LLMEngine:
         self._thread = threading.Thread(target=self._loop, name="llm-engine", daemon=True)
         self._thread.start()
 
+    # stop() waits this long for the loop thread before declaring it
+    # wedged (class attr so tests can tighten it)
+    STOP_JOIN_S = 30.0
+
     def stop(self) -> None:
         self._stop.set()
         self._wake.set()
-        if self._thread is not None:
-            self._thread.join(timeout=30)
+        thread = self._thread
+        if thread is not None:
+            thread.join(timeout=self.STOP_JOIN_S)
+            if thread.is_alive():
+                # the loop is stuck inside a device call but STILL OWNS the
+                # loop-thread-only state (slots, admission heap, chunk
+                # jobs): draining here would race its own teardown when the
+                # device finally answers, double-completing requests. Leave
+                # everything to the live loop and shout — the stop flag is
+                # set, so it exits (and fails its requests) the moment the
+                # wedged call returns.
+                if self.logger is not None:
+                    self.logger.errorf(
+                        "engine loop thread failed to exit within %.0fs "
+                        "(stuck in a device call); leaving teardown to the "
+                        "live loop", self.STOP_JOIN_S)
+                return
             self._thread = None
         if self._plane is not None:
             # leader: publish the stop sentinel AFTER the loop exits (no
@@ -1282,6 +1384,8 @@ class LLMEngine:
         program = self._chunk_program(chunk, K, first=(start == 0),
                                       final=final)
         try:
+            if self.faults is not None:
+                self.faults.hit("engine.chunk")
             if self._q8:
                 (self.k_cache, self.v_cache, self.k_scale, self.v_scale,
                  job["selected"], self._tokens, self._positions, self._temps,
@@ -1494,6 +1598,8 @@ class LLMEngine:
         self._spec_no_draft_streak = 0
         start = time.time()
         try:
+            if self.faults is not None:
+                self.faults.hit("engine.verify")
             out_tokens, n_emit = self._verify_call(jnp.asarray(drafts),
                                                    jnp.asarray(lens))
         except Exception as exc:
@@ -1559,6 +1665,8 @@ class LLMEngine:
             self._last_step_at = time.monotonic()
             try:
                 host_t0 = time.time()
+                if self.breaker.probe_due():
+                    self._breaker_probe()
                 with self._state_lock:
                     self._admit()
                     # one chunk per iteration: decode dispatches below and
@@ -1620,6 +1728,36 @@ class LLMEngine:
                 slot.request.error = stop_exc
                 self._finish_slot(slot)
 
+    def _breaker_probe(self) -> None:
+        """The reset-storm breaker's half-open probe: ONE tiny device
+        round-trip decides whether the storm is over. Success closes the
+        breaker (admission resumes, parked/replayed requests dispatch);
+        failure re-opens it for another cooldown. Runs on the loop thread
+        so a wedged probe shows up as a stall, never a new thread leak."""
+        try:
+            if self.faults is not None:
+                self.faults.hit("engine.probe")
+            float(self._jnp.asarray(1.0) + 1.0)
+        except Exception as exc:  # noqa: BLE001 - device still sick
+            self.breaker.probe_failed()
+            self._obs.gauge("app_tpu_breaker_state", self.breaker.state_code)
+            if self.recorder is not None:
+                self.recorder.record_engine_event("breaker_probe_failed",
+                                                  error=str(exc))
+            if self.logger is not None:
+                self.logger.errorf("breaker half-open probe failed: %s", exc)
+        else:
+            if self.breaker.probe_ok():
+                self._obs.gauge("app_tpu_breaker_state",
+                                self.breaker.state_code)
+                if self.recorder is not None:
+                    self.recorder.record_engine_event("breaker_closed")
+                if self.logger is not None:
+                    self.logger.warnf(
+                        "breaker closed: device answered the half-open "
+                        "probe; resuming admission")
+                self._wake.set()
+
     def _admit(self) -> None:
         """Fuse pending requests into batched prefill dispatches, one per
         (bucket, K) group.
@@ -1636,6 +1774,11 @@ class LLMEngine:
             # (multi-controller: the drain must ride a wave instead — the
             # heap clear has to land on every rank at the same iteration)
             self._drain_pending(EngineDrainingError())
+            return
+        if self._plane is None and self.breaker.blocked():
+            # breaker open/half-open: nothing admits (queued and replayed
+            # requests stay parked) until the probe closes it — new device
+            # work mid-storm would just feed the storm
             return
         free = [i for i, slot in enumerate(self.slots)
                 if not slot.active and slot.chunking is None]
@@ -1785,25 +1928,29 @@ class LLMEngine:
                         sum(1 for s in self.slots if s.active))
 
     def _admission_bucket(self, request: GenerationRequest) -> int:
-        """The prefill bucket this request admits under. The paged engine
-        overrides it to the un-cached TAIL's bucket on a prefix hit."""
-        return next_bucket(len(request.prompt_tokens), self.prefill_buckets)
+        """The prefill bucket this request admits under: resume_tokens so a
+        replay-after-reset re-admission prefills prompt + already-delivered
+        tokens (identical to the prompt for fresh requests). The paged
+        engine overrides it to the un-cached TAIL's bucket on a prefix
+        hit."""
+        return next_bucket(len(request.resume_tokens), self.prefill_buckets)
 
     def _prep_admission(self, bucket: int, batch: List[GenerationRequest]):
         """Host-side admission arrays shared by the dense and paged engines:
-        (ptokens [K, bucket], lengths [K], temperatures [K])."""
+        (ptokens [K, bucket], lengths [K], temperatures [K]). Windows are
+        resume_tokens — replayed requests rebuild their full context."""
         import numpy as np
 
         from .. import native
 
         K = len(batch)
-        ptokens = native.pad_batch([r.prompt_tokens for r in batch], bucket)
+        windows = [r.resume_tokens for r in batch]
+        ptokens = native.pad_batch(windows, bucket)
         if ptokens is None:  # no C++ toolchain: numpy fallback
             ptokens = np.zeros((K, bucket), dtype=np.int32)
-            for row, request in enumerate(batch):
-                ptokens[row, :len(request.prompt_tokens)] = request.prompt_tokens
-        lengths = np.asarray([len(r.prompt_tokens) for r in batch],
-                             dtype=np.int32)
+            for row, window in enumerate(windows):
+                ptokens[row, :len(window)] = window
+        lengths = np.asarray([len(w) for w in windows], dtype=np.int32)
         if self.sampling_controls:
             new_temps = pack_controls(
                 [r.temperature for r in batch],
@@ -1841,10 +1988,14 @@ class LLMEngine:
                                now - request.enqueued_at)
             slot = self.slots[slots_idx[row]]
             slot.request = request
-            # length counts tokens whose KV is in the cache (the prompt); the
+            # length counts tokens whose KV is in the cache (the admission
+            # window — prompt, plus delivered tokens on a replay); the
             # first sampled token is written at `length` by the next decode
-            slot.length = len(request.prompt_tokens)
-            slot.remaining = request.max_new_tokens - 1
+            slot.length = len(request.resume_tokens)
+            # budget counts EMISSIONS, so a replayed request resumes with
+            # what it has left, never a fresh allowance (generated == 0 for
+            # fresh requests: identical to max_new_tokens - 1)
+            slot.remaining = request.max_new_tokens - request.generated - 1
             if self.speculative_tokens and self._spec_cooloff > 0:
                 # fresh traffic probes immediately: the cold streak that
                 # engaged this cooloff belonged to DIFFERENT requests, and
@@ -1880,6 +2031,8 @@ class LLMEngine:
             self._grow_cache(bucket + 1)
         program = self._prefill_program(bucket, K)
         try:
+            if self.faults is not None:
+                self.faults.hit("engine.prefill")
             if self._q8:
                 (self.k_cache, self.v_cache, self.k_scale, self.v_scale,
                  self._tokens, self._positions, self._temps, self.rng,
@@ -1937,6 +2090,8 @@ class LLMEngine:
                     if slot.active]
         start = time.time()
         try:
+            if self.faults is not None:
+                self.faults.hit("engine.decode")
             if self._q8:
                 (self.k_cache, self.v_cache, self.k_scale, self.v_scale,
                  self._tokens, self._positions, self.rng, out_tokens) = \
@@ -1959,6 +2114,10 @@ class LLMEngine:
     def _sync_oldest(self) -> None:
         import numpy as np
 
+        if self.faults is not None:
+            # sync-site chaos: latency (delay rules) or a simulated PJRT
+            # failure (raise rules) at the host sync point
+            self.faults.hit("engine.sync")
         entry = self._inflight.popleft()
         if entry[0] == "prefill":
             _, first, admitted, dspan, dispatched_at = entry
@@ -1974,20 +2133,26 @@ class LLMEngine:
                 dspan.end()
             now = time.time()
             self.util.record_prefill(
-                tokens=sum(len(r.prompt_tokens) for _, r in admitted),
+                tokens=sum(len(r.resume_tokens) for _, r in admitted),
                 dispatched_at=dispatched_at, synced_at=now,
                 sync_wait_s=now - sync_t0)
             for row, (slot_idx, request) in enumerate(admitted):
                 slot = self.slots[slot_idx]
                 if slot.request is not request:  # cancelled between dispatch+sync
                     continue
-                request.first_token_at = now
-                if self.recorder is not None:
-                    self.recorder.record_first_token(request)
-                self._obs.hist("app_tpu_ttft_seconds", now - request.enqueued_at)
+                if request.first_token_at is None:
+                    # replay re-admissions must not overwrite the stamp or
+                    # double-count TTFT: the client saw its first token on
+                    # the ORIGINAL admission
+                    request.first_token_at = now
+                    if self.recorder is not None:
+                        self.recorder.record_first_token(request)
+                    self._obs.hist("app_tpu_ttft_seconds",
+                                   now - request.enqueued_at)
                 token = int(first_host[row])
                 if self.speculative_tokens:
-                    slot.history = list(request.prompt_tokens) + [token]
+                    # resume_tokens read BEFORE the emit below appends
+                    slot.history = list(request.resume_tokens) + [token]
                 self._emit(request, token)
                 if (request.hit_stop(token) or slot.remaining <= 0
                         or self._is_cancelled(request)):
@@ -2163,6 +2328,7 @@ class LLMEngine:
 
     def _emit(self, request: GenerationRequest, token: int) -> None:
         request.generated += 1
+        request.emitted.append(token)  # the replay ledger (resume_tokens)
         request.out_queue.put(token)
         self._obs.counter("app_tpu_tokens_generated_total")
 
@@ -2210,10 +2376,30 @@ class LLMEngine:
 
     def _reset_device_state(self, exc: BaseException) -> None:
         """Rebuild all device state after a failed donated-cache program
-        (donation means the old buffers may be deleted on TPU/GPU) and fail
-        every active request, whose cached context no longer exists."""
+        (donation means the old buffers may be deleted on TPU/GPU), then
+        REPLAY the interrupted requests instead of failing them: the host
+        still holds each one's prompt and every token it already delivered
+        (GenerationRequest.emitted), so survivors re-admit at prompt +
+        emitted with their remaining budget and elevated priority — the
+        client's stream pauses, no position is re-emitted or dropped.
+        Bounded by retry_budget, with poison quarantine (a request that
+        was sole-in-flight across >= 2 consecutive resets fails instead of
+        reset-looping the engine) and the reset-storm breaker counting
+        every pass through here."""
+        self.resets_total += 1
+        self._obs.counter("app_tpu_device_resets_total")
         if self.recorder is not None:
             self.recorder.record_engine_event("device_reset", error=str(exc))
+        if self.breaker.record_reset():
+            if self.recorder is not None:
+                self.recorder.record_engine_event(
+                    "breaker_open", **self.breaker.snapshot())
+            if self.logger is not None:
+                self.logger.errorf(
+                    "reset storm: %d resets inside %.0fs — breaker OPEN, "
+                    "shedding submits until the half-open probe passes",
+                    self.breaker.max_resets, self.breaker.window_s)
+        self._obs.gauge("app_tpu_breaker_state", self.breaker.state_code)
         with self._state_lock:
             # close the dispatch spans of everything in flight — the trace
             # record matters MOST for the window a device error destroyed
@@ -2223,13 +2409,90 @@ class LLMEngine:
                     dspan.set_status(False, str(exc))
                     dspan.end()
             self._inflight.clear()
-            while self._chunk_jobs:  # mid-prefill KV rows died with the cache
-                self._abort_chunk_job(self._chunk_jobs.popleft(), exc)
+            survivors: List[GenerationRequest] = []
+            while self._chunk_jobs:  # mid-prefill KV rows died with the
+                job = self._chunk_jobs.popleft()  # cache; nothing emitted
+                for slot_idx in job["slots_idx"]:  # yet, so they replay too
+                    self.slots[slot_idx].chunking = None
+                survivors.extend(job["batch"])
             for slot in self.slots:
                 if slot.active:
-                    slot.request.error = exc
-                    self._finish_slot(slot)
+                    survivors.append(slot.request)
+                    # evacuate WITHOUT terminating: no out_queue sentinel,
+                    # no span end — the request lives on in the replay
+                    # queue. Pages are not released (paged: the allocator
+                    # is rebuilt wholesale by _init_device_state below)
+                    slot.request = None
+                    slot.length = 0
+                    slot.remaining = 0
+                    slot.history = None
+                    slot.pages = None
             self._init_device_state()
+            self._replay_or_fail(survivors, exc)
+
+    def _replay_or_fail(self, survivors: List[GenerationRequest],
+                        exc: BaseException) -> None:
+        """Requeue each reset survivor for replay, or fail it when it is
+        out of budget / poisoned / cancelled / no longer admissible.
+        Loop-thread-only, under the state lock, after device state was
+        rebuilt (the admission heap is loop-thread state)."""
+        import heapq
+
+        if len(survivors) == 1 and self._sole_reset_id == survivors[0].id:
+            self._sole_reset_streak += 1
+        else:
+            self._sole_reset_id = (survivors[0].id if len(survivors) == 1
+                                   else None)
+            self._sole_reset_streak = 1 if self._sole_reset_id else 0
+        for request in survivors:
+            if self._plane is not None:
+                # multi-controller: a replay requeue would have to ride an
+                # admission wave to stay SPMD-symmetric across ranks; until
+                # that exists, fail loudly (the pre-replay behavior)
+                self._fail_request(request, exc)
+                continue
+            if self._is_cancelled(request):
+                self._fail_request(request)
+                continue
+            poisoned = (request.id == self._sole_reset_id
+                        and self._sole_reset_streak >= 2)
+            if poisoned:
+                self.quarantined_total += 1
+                self._obs.counter("app_tpu_requests_quarantined_total")
+                if self.recorder is not None:
+                    self.recorder.record_event(
+                        request.id, "quarantined",
+                        consecutive_sole_resets=self._sole_reset_streak)
+                if self.logger is not None:
+                    self.logger.errorf(
+                        "request %d quarantined: sole in-flight work "
+                        "across %d consecutive device resets",
+                        request.id, self._sole_reset_streak)
+                self._fail_request(request, exc)
+                continue
+            budget_left = request.max_new_tokens - request.generated
+            if (request.replays >= self.retry_budget or budget_left <= 0
+                    or len(request.resume_tokens) > self.admission_limit):
+                self._fail_request(request, exc)
+                continue
+            request.replays += 1
+            # replays outrank queued arrivals (priority is LOWER-first and
+            # clients are clamped to >= 0): an interrupted stream resumes
+            # before fresh work starts
+            request.priority = min(request.priority, -1)
+            request.admitted_at = None  # re-stamped at re-admission
+            self.replays_total += 1
+            self.replayed_tokens_total += len(request.emitted)
+            self._obs.counter("app_tpu_request_replays_total")
+            self._obs.counter("app_tpu_replayed_tokens_total",
+                              float(len(request.emitted)))
+            if self.recorder is not None:
+                self.recorder.record_event(
+                    request.id, "replayed", attempt=request.replays,
+                    replayed_tokens=len(request.emitted))
+            heapq.heappush(self._admission_heap,
+                           (request.priority, request.id, request))
+        self._wake.set()
 
     def _is_cancelled(self, request: GenerationRequest) -> bool:
         """Cancellation as the DISPATCH path must see it. Single-controller:

@@ -89,6 +89,9 @@ class Executor:
         self.metrics = metrics if metrics is not None else getattr(tpu_client, "metrics", None)
         self._cache: Dict[Tuple, CompiledProgram] = {}
         self._lock = threading.Lock()
+        # fault-injection plane (tpu/faults.py): None in production; armed
+        # deployments can add latency to (or fail) compile lookups
+        self.faults = None
         # compiled-program persistence (SURVEY §2.5 item 2): serialized PJRT
         # executables keyed by (program, shapes, backend); a second boot
         # loads them instead of re-tracing + re-compiling
@@ -313,6 +316,9 @@ class Executor:
         import jax
 
         import re as _re
+
+        if self.faults is not None:  # chaos drills: slow/failed compiles
+            self.faults.hit("executor.compile", name=name)
 
         shard_sig = ""
         if in_shardings is not None or out_shardings is not None:

@@ -224,7 +224,11 @@ class PagedLLMEngine(LLMEngine):
                               top_k=top_k, traceparent=traceparent)
 
     def _request_pages(self, request: GenerationRequest) -> int:
-        total = min(len(request.prompt_tokens) + request.max_new_tokens,
+        # resume_tokens + remaining budget == prompt + max_new for fresh
+        # requests AND for replays (delivered tokens moved from budget to
+        # window), so reservations are reset-stable by construction
+        total = min(len(request.resume_tokens)
+                    + (request.max_new_tokens - request.generated),
                     self.max_seq_len)
         return self.allocator.pages_for(total)
 
@@ -234,7 +238,7 @@ class PagedLLMEngine(LLMEngine):
         shared: List[int] = []
         if self.prefix is not None:
             if request.id not in self._prefix_hits:
-                hit = self.prefix.match(request.prompt_tokens)
+                hit = self.prefix.match(request.resume_tokens)
                 if hit and self._tail_routes_to_chunk(request, hit):
                     # the tail would still chunk: drop the hit NOW, before
                     # the reservation is sized — deciding later would leave
@@ -280,7 +284,7 @@ class PagedLLMEngine(LLMEngine):
                      shared: List[int]) -> int:
         from .executor import next_bucket
 
-        tail = len(request.prompt_tokens) - len(shared) * self.page_size
+        tail = len(request.resume_tokens) - len(shared) * self.page_size
         return next_bucket(max(1, tail), self.prefill_buckets)
 
     def _tail_routes_to_chunk(self, request: GenerationRequest,
@@ -767,6 +771,8 @@ class PagedLLMEngine(LLMEngine):
             (K, chunk))
         program = self._chunk_program_paged(chunk, K, job["bucket"], final)
         try:
+            if self.faults is not None:
+                self.faults.hit("engine.chunk")
             if not final:
                 job["tmp_k"], job["tmp_v"], job["selected"] = program(
                     self.params, job["tmp_k"], job["tmp_v"],
@@ -960,9 +966,9 @@ class PagedLLMEngine(LLMEngine):
         K = len(batch)
         prefix_lens = np.asarray([len(h) * ps for h in hits],
                                  dtype=np.int32)
-        lengths = np.asarray([len(r.prompt_tokens) for r in batch],
+        lengths = np.asarray([len(r.resume_tokens) for r in batch],
                              dtype=np.int32)
-        tails = [r.prompt_tokens[len(h) * ps:]
+        tails = [r.resume_tokens[len(h) * ps:]
                  for r, h in zip(batch, hits)]
         ptokens = native.pad_batch(tails, bucket)
         if ptokens is None:
@@ -996,6 +1002,8 @@ class PagedLLMEngine(LLMEngine):
 
         program = self._prefix_program(bucket, K, n_table)
         try:
+            if self.faults is not None:
+                self.faults.hit("engine.prefill")
             if self._q8:
                 (self.k_cache, self.v_cache, self.k_scale, self.v_scale,
                  self._tokens, self._positions, self._temps, self.rng,
@@ -1039,7 +1047,7 @@ class PagedLLMEngine(LLMEngine):
             slot = self.slots[slots_idx[row]]
             slot.pages = list(shared) + fresh
             if self.prefix is not None:
-                self.prefix.insert(request.prompt_tokens, slot.pages)
+                self.prefix.insert(request.resume_tokens, slot.pages)
 
     # -- dispatch -------------------------------------------------------------
     def _build_table(self) -> np.ndarray:
@@ -1082,6 +1090,8 @@ class PagedLLMEngine(LLMEngine):
 
         program = self._prefill_program(bucket, K)
         try:
+            if self.faults is not None:
+                self.faults.hit("engine.prefill")
             if self._q8:
                 (self.k_cache, self.v_cache, self.k_scale, self.v_scale,
                  self._tokens, self._positions, self._temps, self.rng,
@@ -1125,6 +1135,8 @@ class PagedLLMEngine(LLMEngine):
                     if slot.active]
         start = _time.time()
         try:
+            if self.faults is not None:
+                self.faults.hit("engine.decode")
             if self._q8:
                 (self.k_cache, self.v_cache, self.k_scale, self.v_scale,
                  self._tokens, self._positions, self.rng, out_tokens) = \
@@ -1148,10 +1160,11 @@ class PagedLLMEngine(LLMEngine):
                                block, start, dspan))
 
     def _reset_device_state(self, exc: BaseException) -> None:
-        # releasing slot pages happens via _finish_slot inside super(),
-        # against the old allocator; _init_device_state then rebuilds the
-        # allocator wholesale (super holds the state lock; only the loop
-        # thread touches _reservations, so clearing here is safe)
+        # slot pages are NOT released individually: _init_device_state
+        # (inside super()) rebuilds the allocator + prefix cache wholesale,
+        # and replayed survivors re-reserve against the fresh pool at
+        # re-admission (super holds the state lock; only the loop thread
+        # touches _reservations, so clearing here is safe)
         self._reservations.clear()
         self._prefix_hits.clear()
         super()._reset_device_state(exc)

@@ -98,18 +98,38 @@ class DynamicBatcher:
         self._thread = threading.Thread(target=self._loop, name=self.name, daemon=True)
         self._thread.start()
 
+    # stop() waits this long for the loop thread before declaring it stuck
+    # (class attr so tests can tighten it)
+    STOP_JOIN_S = 5.0
+
     def stop(self) -> None:
         self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=5)
+        thread = self._thread
+        if thread is not None:
+            thread.join(timeout=self.STOP_JOIN_S)
+            if thread.is_alive():
+                # still inside _run_batch (device call): failing the queue
+                # here would race the live loop's own future completion —
+                # double-completing a Future raises InvalidStateError in
+                # whichever thread loses. The live loop drains the queue
+                # itself when it exits; just shout and leave it to it.
+                if self.logger is not None:
+                    self.logger.errorf(
+                        "batcher %s loop still running after %.0fs; leaving "
+                        "queue draining to the live loop", self.name,
+                        self.STOP_JOIN_S)
+                return
             self._thread = None
-        while True:  # fail anything still queued
+        self._fail_queued(RuntimeError("batcher stopped"))
+
+    def _fail_queued(self, exc: BaseException) -> None:
+        while True:
             try:
                 item = self._queue.get_nowait()
             except queue.Empty:
                 break
             if not item.future.done():
-                item.future.set_exception(RuntimeError("batcher stopped"))
+                item.future.set_exception(exc)
 
     # -- device loop ----------------------------------------------------------
     def _collect(self) -> list:
@@ -143,6 +163,12 @@ class DynamicBatcher:
                 for item in items:
                     if not item.future.done():
                         item.future.set_exception(exc)
+        # the loop owns queue draining on the way out: when stop() timed
+        # out waiting (loop was mid-batch), items that queued behind that
+        # batch still need a terminal outcome — and completing them HERE
+        # (the only thread that also completes batch futures) is what makes
+        # the stop()/_run_batch race impossible by construction
+        self._fail_queued(RuntimeError("batcher stopped"))
 
     def _run_batch(self, items: list) -> None:
         import jax.numpy as jnp

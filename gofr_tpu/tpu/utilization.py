@@ -456,6 +456,22 @@ def engine_snapshot(engine, tpu=None) -> Dict[str, Any]:
             except Exception:  # noqa: BLE001
                 pass
 
+    breaker = getattr(engine, "breaker", None)
+    if breaker is not None:
+        out["breaker"] = breaker.snapshot()
+    # crash-only recovery evidence (plain engine counters, metrics-free)
+    if hasattr(engine, "resets_total"):
+        out["recovery"] = {
+            "resets_total": engine.resets_total,
+            "replays_total": engine.replays_total,
+            "replayed_tokens_total": engine.replayed_tokens_total,
+            "quarantined_total": engine.quarantined_total,
+            "retry_budget": getattr(engine, "retry_budget", None),
+        }
+    faults = getattr(engine, "faults", None)
+    if faults is not None:
+        out["faults"] = faults.snapshot()
+
     util = getattr(engine, "util", None)
     if util is not None:
         out["utilization"] = util.window_stats()

@@ -235,6 +235,146 @@ def test_drain_races_concurrent_submitters():
     assert outcomes, "no submitter ever ran"
 
 
+def test_drain_submit_cancel_race_every_client_terminal():
+    """Concurrent drain() + submit() + cancel(): EVERY client observes a
+    terminal outcome — a full token stream, a 503 EngineDrainingError, or
+    a clean cancel — and no future/request is left hanging (queue, heap,
+    and slots all empty after the dust settles)."""
+    import time
+
+    from gofr_tpu.models.llama import LlamaConfig, llama_init
+    from gofr_tpu.tpu.engine import EngineDrainingError, LLMEngine
+
+    cfg = LlamaConfig.debug()
+    eng = LLMEngine(llama_init(cfg, seed=0), cfg, n_slots=4, max_seq_len=64,
+                    prefill_buckets=(8,), logger=MockLogger())
+    eng.start()
+    outcomes = []
+    lock = threading.Lock()
+    stop_submitting = threading.Event()
+    try:
+        eng.generate([1, 2, 3], max_new_tokens=4)  # warm the programs
+
+        def work(i):
+            if i == 0:
+                time.sleep(0.25)
+                drained = eng.drain(timeout_s=120)
+                stop_submitting.set()
+                assert drained, "drain timed out: busy state leaked"
+                return
+            rng_cancel = i % 3 == 0
+            while not stop_submitting.is_set():
+                try:
+                    req = eng.submit([1 + i, 2, 3], max_new_tokens=4)
+                except EngineDrainingError:
+                    with lock:
+                        outcomes.append("rejected")
+                    return
+                if rng_cancel:
+                    req.cancel()
+                try:
+                    out = req.result(timeout_s=120)
+                    with lock:
+                        outcomes.append("cancelled" if rng_cancel
+                                        else len(out))
+                except EngineDrainingError:
+                    # queued behind the drain: failed fast, still terminal
+                    with lock:
+                        outcomes.append("failed-queued")
+
+        _hammer(10, work)
+        # nothing hangs: every structure the clients touched is empty
+        assert eng._pending.qsize() == 0
+        assert not eng._admission_heap
+        assert not any(s.active or s.chunking is not None for s in eng.slots)
+    finally:
+        eng.stop()
+    # completed generations are FULL length (drain never truncates), and
+    # at least one client actually exercised each path class
+    assert all(o == 4 for o in outcomes if isinstance(o, int)), outcomes
+    assert outcomes, "no submitter ever ran"
+
+
+def test_dynamic_batcher_stop_does_not_race_live_loop():
+    """stop() timing out while the loop is mid-batch must NOT null the
+    thread and double-complete queued futures — the live loop keeps
+    ownership, completes the in-flight batch, and drains the queue itself
+    on exit (scheduler.py stop/is_alive race)."""
+    import time
+
+    from gofr_tpu.tpu.scheduler import DynamicBatcher, _WorkItem
+
+    gate = threading.Event()
+    entered = threading.Event()
+
+    def model_fn(batch):
+        entered.set()
+        gate.wait(timeout=30)
+        return batch
+
+    batcher = DynamicBatcher(model_fn, max_batch=2, window_s=0.01,
+                             logger=MockLogger())
+    batcher.STOP_JOIN_S = 0.2
+    batcher.start()
+    fut = batcher.submit(np.zeros((2,), dtype=np.float32))
+    assert entered.wait(timeout=30), "loop never entered the batch"
+    # anything racing in behind the in-flight batch stays queued
+    batcher._queue.put(_WorkItem(np.ones((2,), dtype=np.float32)))
+    batcher.stop()  # join times out: loop still alive inside model_fn
+    assert batcher._thread is not None, "stop() nulled a live thread"
+    assert not fut.done(), "stop() completed a future the loop still owns"
+    gate.set()
+    np.testing.assert_array_equal(np.asarray(fut.result(timeout=30)),
+                                  np.zeros((2,), dtype=np.float32))
+    # the LOOP drained the stragglers on exit — exactly once, no race
+    deadline = time.time() + 30
+    while batcher._queue.qsize() and time.time() < deadline:
+        time.sleep(0.02)
+    assert batcher._queue.qsize() == 0
+
+
+def test_engine_stop_with_wedged_loop_leaves_state_to_live_loop():
+    """LLMEngine.stop() timing out against a loop stuck in a device call
+    must not mutate loop-owned state (engine.py stop/is_alive race): the
+    thread stays registered, and when the device answers the loop finishes
+    its own teardown."""
+    from gofr_tpu.models.llama import LlamaConfig, llama_init
+    from gofr_tpu.tpu.engine import LLMEngine
+
+    cfg = LlamaConfig.debug()
+    eng = LLMEngine(llama_init(cfg, seed=0), cfg, n_slots=2, max_seq_len=64,
+                    prefill_buckets=(8,), logger=MockLogger())
+    eng.STOP_JOIN_S = 0.2
+    eng.start()
+    eng.generate([1, 2, 3], max_new_tokens=3)  # warm
+
+    gate = threading.Event()
+    orig_sync = eng._sync_oldest
+
+    def stuck_sync():
+        gate.wait(timeout=30)
+        return orig_sync()
+
+    import time
+
+    eng._sync_oldest = stuck_sync
+    req = eng.submit([4, 5, 6], max_new_tokens=4)
+    deadline = time.time() + 30
+    while not eng._inflight and time.time() < deadline:
+        time.sleep(0.01)
+
+    eng.stop()  # join times out against the gated sync
+    assert eng._thread is not None, "stop() nulled a live loop thread"
+    gate.set()
+    eng._sync_oldest = orig_sync
+    # the LIVE loop finishes the dispatched work and fails nothing mid-air
+    assert len(req.result(timeout_s=60)) == 4
+    eng._thread.join(timeout=30)
+    assert not eng._thread.is_alive()
+    eng._thread = None
+    eng.stop()  # now a clean no-op drain
+
+
 def test_prefix_cache_engine_concurrent_submit_cancel():
     """Prefix-cache bookkeeping (match refs, owner-insert, leaf-first
     eviction under pool pressure, unref at finish AND at cancel-abort)

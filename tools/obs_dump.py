@@ -35,7 +35,8 @@ import urllib.request
 SLO_GAUGES = ("app_tpu_slo_ttft_goodput", "app_tpu_slo_tpot_goodput",
               "app_tpu_tokens_per_second", "app_tpu_engine_stall_seconds",
               "app_tpu_active_slots", "app_tpu_queue_depth",
-              "app_tpu_device_duty_cycle", "app_tpu_host_overhead_seconds")
+              "app_tpu_device_duty_cycle", "app_tpu_host_overhead_seconds",
+              "app_tpu_breaker_state")
 
 
 def _get(url: str, timeout: float = 5.0) -> str:
@@ -73,7 +74,11 @@ def poll_once(server: str, metrics_base: str) -> dict:
         snap = body.get("data", body)
         engine = {"engine": snap.get("engine"),
                   "utilization": snap.get("utilization"),
-                  "page_pool": snap.get("page_pool")}
+                  "page_pool": snap.get("page_pool"),
+                  # crash-only surfaces: breaker state (open = the server
+                  # is shedding with 503s) + reset/replay totals
+                  "breaker": snap.get("breaker"),
+                  "recovery": snap.get("recovery")}
         compile_table = snap.get("compile") or {}
         # totals only — the per-program rows would bloat the JSONL stream
         engine["compile"] = {k: compile_table.get(k) for k in (

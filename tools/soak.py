@@ -136,8 +136,18 @@ def _soak(engine, seconds: float, n_threads: int, vocab: int) -> dict:
     return stats
 
 
+# the mid-soak chaos schedule (--chaos): two injected decode-dispatch
+# failures, far enough apart that the engine fully recovers between them.
+# Deterministic per --chaos-seed; recovery evidence (resets, replays,
+# failed requests — expected 0 within the retry budget) lands in the
+# JSON artifact next to the throughput numbers.
+CHAOS_PLAN = [
+    {"site": "engine.decode", "every": 40, "times": 2, "action": "raise"},
+]
+
+
 def run_profile(profile: str, seconds: float, n_threads: int,
-                preset: str) -> bool:
+                preset: str, chaos: bool = False, chaos_seed: int = 0) -> bool:
     from gofr_tpu.tpu.flightrecorder import FlightRecorder
 
     engine = _build(profile, preset)
@@ -145,6 +155,20 @@ def run_profile(profile: str, seconds: float, n_threads: int,
     # completions' phase timings + SLO goodput land in the JSON artifact,
     # so a blown-tail run is diagnosable without re-reproduction
     engine.recorder = recorder = FlightRecorder(capacity=512)
+    chaos_armed_at = None
+    if chaos:
+        from gofr_tpu.tpu.faults import FaultPlane
+
+        # attach DISARMED (empty plan: one attribute check + an early
+        # return per dispatch), then arm the seeded schedule mid-soak so
+        # recovery runs under real concurrent load, not a cold engine
+        plane = FaultPlane(seed=chaos_seed)
+        engine.faults = plane
+        chaos_armed_at = max(1.0, seconds / 3.0)
+        arm_timer = threading.Timer(
+            chaos_armed_at, lambda: plane.arm(CHAOS_PLAN, seed=chaos_seed))
+        arm_timer.daemon = True
+        arm_timer.start()
     engine.start()
     engine.warmup()
     t0 = time.time()
@@ -158,6 +182,31 @@ def run_profile(profile: str, seconds: float, n_threads: int,
     snap = recorder.snapshot()
     stats["slo"] = snap["slo"]
     stats["engine_events"] = snap["engine_events"]
+    if chaos:
+        resets = [e for e in snap["engine_events"]
+                  if e["event"] == "device_reset"]
+        # time-to-recover: last reset -> first completion finishing after
+        # it (recent summaries carry enqueued_at + total_s)
+        ttr = None
+        if resets:
+            last_reset = resets[-1]["t"]
+            finishes = sorted(
+                r["enqueued_at"] + r["phases"]["total_s"]
+                for r in snap["recent"] if "total_s" in r.get("phases", {}))
+            after = [f for f in finishes if f >= last_reset]
+            if after:
+                ttr = round(after[0] - last_reset, 3)
+        stats["chaos"] = {
+            "plan": CHAOS_PLAN, "seed": chaos_seed,
+            "armed_at_s": round(chaos_armed_at, 1),
+            "resets": engine.resets_total,
+            "replays": engine.replays_total,
+            "replayed_tokens": engine.replayed_tokens_total,
+            "quarantined": engine.quarantined_total,
+            "breaker": engine.breaker.snapshot(),
+            "failed_requests": stats["errors"],  # gate: 0 within budget
+            "time_to_recover_s": ttr,
+        }
     # efficiency axis (tpu/utilization.py): final MFU/MBU/duty-cycle so
     # BENCH_*.json judges throughput AGAINST the hardware roofline, not
     # just in absolute tokens/sec
@@ -256,6 +305,10 @@ def main() -> int:
                                  "all"])
     parser.add_argument("--seconds", type=float, default=120.0)
     parser.add_argument("--threads", type=int, default=4)
+    parser.add_argument("--chaos", action="store_true",
+                        help="arm a seeded fault plan mid-soak and embed "
+                             "recovery evidence in the JSON artifact")
+    parser.add_argument("--chaos-seed", type=int, default=0)
     args = parser.parse_args()
 
     platform = os.environ.get("SOAK_PLATFORM", "cpu")
@@ -277,7 +330,9 @@ def main() -> int:
                        else args.seconds)
             results.append(run_multihost(seconds))
         else:
-            results.append(run_profile(p, args.seconds, args.threads, preset))
+            results.append(run_profile(p, args.seconds, args.threads, preset,
+                                       chaos=args.chaos,
+                                       chaos_seed=args.chaos_seed))
     return 0 if all(results) else 1
 
 
