@@ -336,6 +336,12 @@ def build_app(config=None, engine=None) -> App:
     # false opts out
     if app.config.get_bool("ENGINE_SNAPSHOT", True):
         app.enable_engine_snapshot(engine)
+    # step anatomy: GET /debug/steps (per-iteration segment attributions +
+    # straggler sentinel) and the exemplar-carrying step histograms;
+    # STEP_LEDGER=false opts out, STEP_LEDGER_CAPACITY / STEP_STRAGGLER_K /
+    # STEP_BASELINE_* tune the ring and sentinel
+    if app.config.get_bool("STEP_LEDGER", True):
+        app.enable_step_ledger(engine)
     # chaos plane: POST /debug/faults + engine/executor/device fault hooks.
     # HARD-gated on FAULT_INJECTION=true — disabled (the default) keeps the
     # zero-overhead faults=None fast path and the endpoint 404s

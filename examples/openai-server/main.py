@@ -81,6 +81,10 @@ def build_app(**kw) -> App:
     # parity; ENGINE_SNAPSHOT=false opts out)
     if app.config.get_bool("ENGINE_SNAPSHOT", True):
         app.enable_engine_snapshot(engine)
+    # GET /debug/steps + step histograms/straggler sentinel (llm-server
+    # parity; STEP_LEDGER=false opts out)
+    if app.config.get_bool("STEP_LEDGER", True):
+        app.enable_step_ledger(engine)
     # chaos plane (llm-server parity): 404s unless FAULT_INJECTION=true
     app.enable_fault_injection(engine)
     tokenizer = engine.tokenizer

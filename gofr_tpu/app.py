@@ -346,6 +346,37 @@ class App:
             self.on_shutdown(sampler.stop)
         return util
 
+    def enable_step_ledger(self, engine, path: str = "/debug/steps"):
+        """Expose the engine's step anatomy ledger (tpu/stepledger.py):
+        GET /debug/steps — recent per-iteration segment attributions,
+        per-phase/segment summary, straggler sentinel baselines and the
+        recent straggler list — plus the app_tpu_step_seconds{phase,
+        segment} histograms (exemplar-carrying) and
+        app_tpu_step_stragglers_total{cause}.
+
+        Config: STEP_LEDGER_CAPACITY (ring size, default 512),
+        STEP_STRAGGLER_K (a step slower than k × the rolling per-phase
+        baseline is flagged, default 3.0), STEP_BASELINE_ALPHA (EWMA
+        smoothing, default 0.1), STEP_BASELINE_MIN_SAMPLES (observations
+        before the sentinel arms, default 16). Returns the ledger (None
+        for engines without one)."""
+        from .tpu.stepledger import install_routes, register_step_metrics
+
+        ledger = getattr(engine, "steps", None)
+        if ledger is None:
+            return None
+        metrics = self.container.metrics_manager
+        if metrics is not None:
+            register_step_metrics(metrics)
+            ledger.use_metrics(metrics)
+        ledger.configure(
+            capacity=self.config.get_int("STEP_LEDGER_CAPACITY", 512),
+            straggler_k=self.config.get_float("STEP_STRAGGLER_K", 3.0),
+            baseline_alpha=self.config.get_float("STEP_BASELINE_ALPHA", 0.1),
+            min_samples=self.config.get_int("STEP_BASELINE_MIN_SAMPLES", 16))
+        install_routes(self, ledger, path)
+        return ledger
+
     # -- cross-cutting registrations ------------------------------------------
     def add_http_service(self, name: str, address: str, *options) -> None:
         from .service import new_http_service
@@ -451,8 +482,18 @@ class App:
 
         def metrics_handler(request: Request) -> Response:
             self.container.refresh_runtime_metrics()
-            return Response(status=200, headers={"Content-Type": "text/plain; version=0.0.4"},
-                            body=self.container.metrics_manager.expose().encode())
+            # content negotiation: a scrape that accepts the OpenMetrics
+            # dialect gets exemplars (metrics→trace→request deep links);
+            # classic Prometheus text stays byte-identical without them
+            openmetrics = ("application/openmetrics-text"
+                           in request.header("accept"))
+            ctype = ("application/openmetrics-text; version=1.0.0; "
+                     "charset=utf-8" if openmetrics
+                     else "text/plain; version=0.0.4")
+            return Response(
+                status=200, headers={"Content-Type": ctype},
+                body=self.container.metrics_manager.expose(
+                    openmetrics=openmetrics).encode())
 
         def health_handler(request: Request) -> Response:
             return Response(status=200, headers={"Content-Type": "application/json"},

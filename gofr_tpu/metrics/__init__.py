@@ -15,9 +15,35 @@ from __future__ import annotations
 import bisect
 import math
 import threading
-from typing import Dict, List, Optional, Sequence, Tuple
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 LabelKey = Tuple[Tuple[str, str], ...]
+
+
+def format_bucket_bound(bound) -> str:
+    """Canonical `le` label rendering, pinned by test_metrics:
+
+      * +inf -> "+Inf"
+      * integral values -> one decimal place ("1.0", not "1"), so an int
+        bucket bound and its float twin can never emit two different
+        series for the same bound
+      * everything else -> shortest positional decimal, never exponent
+        notation (repr's "1e-05" is expanded to "0.00001" — PromQL treats
+        `le` as an opaque string, so "1e-05" and "0.00001" would be
+        DIFFERENT series across clients that render differently)
+    """
+    v = float(bound)
+    if math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    if v == int(v) and abs(v) < 1e15:
+        return f"{int(v)}.0"
+    s = repr(v)
+    if "e" in s or "E" in s:
+        from decimal import Decimal
+
+        s = format(Decimal(s), "f")
+    return s
 
 
 def _label_key(labels: Dict[str, str]) -> LabelKey:
@@ -47,7 +73,7 @@ class _Instrument:
         self.series: Dict[LabelKey, object] = {}
         self.lock = threading.Lock()
 
-    def expose(self) -> List[str]:  # pragma: no cover - interface
+    def expose(self, openmetrics: bool = False) -> List[str]:  # pragma: no cover - interface
         raise NotImplementedError
 
     def _header(self) -> List[str]:
@@ -73,7 +99,7 @@ class Counter(_Instrument):
         with self.lock:
             self.series[key] = float(self.series.get(key, 0.0)) + value  # type: ignore[arg-type]
 
-    def expose(self) -> List[str]:
+    def expose(self, openmetrics: bool = False) -> List[str]:
         # snapshot under the lock: a hot-loop add() inserting a NEW label
         # key during a scrape would otherwise mutate the dict mid-iteration
         # and 500 the /metrics endpoint
@@ -96,7 +122,7 @@ class Gauge(_Instrument):
         with self.lock:
             self.series[_label_key(labels)] = float(value)
 
-    def expose(self) -> List[str]:
+    def expose(self, openmetrics: bool = False) -> List[str]:
         with self.lock:   # see Counter.expose
             series = list(self.series.items())
         out = self._header()
@@ -115,16 +141,27 @@ class Histogram(_Instrument):
         super().__init__(name, desc)
         self.buckets = sorted(buckets)
 
-    def record(self, value: float, **labels: str) -> None:
-        self.record_n(value, 1, **labels)
+    def record(self, value: float,
+               exemplar: Optional[Dict[str, Any]] = None,
+               **labels: str) -> None:
+        self.record_n(value, 1, exemplar=exemplar, **labels)
 
-    def record_n(self, value: float, n: int, **labels: str) -> None:
+    def record_n(self, value: float, n: int,
+                 exemplar: Optional[Dict[str, Any]] = None,
+                 **labels: str) -> None:
         """Record `n` identical observations in one lock acquisition.
 
         The serving hot loop emits one TPOT sample per generated token; at
         thousands of tokens/sec the per-call dict lookup + lock dominates —
         a decode block's tokens all share one measured step time, so they
-        batch losslessly."""
+        batch losslessly.
+
+        `exemplar` (optional, e.g. {"trace_id": ..., "request_id": ...})
+        attaches a correlation handle to the bucket this value lands in,
+        last-write-wins per bucket — the Dapper-style metrics→trace link.
+        Stored exemplars surface ONLY in OpenMetrics exposition (scrapes
+        negotiating `application/openmetrics-text`); classic Prometheus
+        text output is byte-identical with or without them."""
         if n <= 0:
             return
         key = _label_key(labels)
@@ -137,6 +174,13 @@ class Histogram(_Instrument):
             entry["counts"][idx] += n  # type: ignore[index]
             entry["sum"] += value * n  # type: ignore[operator]
             entry["count"] += n  # type: ignore[operator]
+            if exemplar:
+                # per-bucket last-write-wins: one (labels, value, timestamp)
+                # triple per bucket keeps memory O(buckets), and "most
+                # recent offender" is exactly what a deep link should open
+                entry.setdefault("exemplars", {})[idx] = (  # type: ignore[union-attr]
+                    _label_key({k: str(v) for k, v in exemplar.items()}),
+                    float(value), time.time())
 
     def percentile(self, q: float, **labels: str) -> float:
         """Approximate percentile from bucket MIDPOINTS (for tests/health,
@@ -161,25 +205,41 @@ class Histogram(_Instrument):
                 return (lower + self.buckets[i]) / 2.0
         return self.buckets[-1]
 
-    def expose(self) -> List[str]:
+    @staticmethod
+    def _fmt_exemplar(ex: Tuple) -> str:
+        """OpenMetrics exemplar suffix: ` # {labels} value timestamp`."""
+        labels, value, ts = ex
+        inner = ",".join(f'{k}="{_escape_label(v)}"' for k, v in labels)
+        return f" # {{{inner}}} {value} {round(ts, 3)}"
+
+    def expose(self, openmetrics: bool = False) -> List[str]:
         with self.lock:   # see Counter.expose — counts lists mutate in
             # place under record_n, so each entry is deep-copied here
             series = [(key, {"counts": list(entry["counts"]),  # type: ignore[index]
                              "sum": entry["sum"],              # type: ignore[index]
-                             "count": entry["count"]})         # type: ignore[index]
+                             "count": entry["count"],          # type: ignore[index]
+                             "exemplars": dict(entry.get("exemplars") or ())})  # type: ignore[union-attr]
                       for key, entry in self.series.items()]
         out = self._header()
         for key, entry in sorted(series):
+            exemplars = entry["exemplars"] if openmetrics else {}
             cum = 0
             for i, bound in enumerate(self.buckets):
                 cum += entry["counts"][i]  # type: ignore[index]
                 lk = dict(key)
-                lk["le"] = repr(bound) if isinstance(bound, float) else str(bound)
-                out.append(f"{self.name}_bucket{_fmt_labels(_label_key(lk))} {cum}")
+                lk["le"] = format_bucket_bound(bound)
+                tail = (self._fmt_exemplar(exemplars[i])
+                        if i in exemplars else "")
+                out.append(f"{self.name}_bucket"
+                           f"{_fmt_labels(_label_key(lk))} {cum}{tail}")
             cum += entry["counts"][-1]  # type: ignore[index]
             lk = dict(key)
             lk["le"] = "+Inf"
-            out.append(f"{self.name}_bucket{_fmt_labels(_label_key(lk))} {cum}")
+            overflow = len(self.buckets)
+            tail = (self._fmt_exemplar(exemplars[overflow])
+                    if overflow in exemplars else "")
+            out.append(f"{self.name}_bucket"
+                       f"{_fmt_labels(_label_key(lk))} {cum}{tail}")
             out.append(f"{self.name}_sum{_fmt_labels(key)} {entry['sum']}")  # type: ignore[index]
             out.append(f"{self.name}_count{_fmt_labels(key)} {entry['count']}")  # type: ignore[index]
         return out
@@ -236,24 +296,35 @@ class Manager:
     def set_gauge(self, name: str, value: float, **labels: str) -> None:
         self._get(name, Gauge).set(value, **labels)  # type: ignore[attr-defined]
 
-    def record_histogram(self, name: str, value: float, **labels: str) -> None:
-        self._get(name, Histogram).record(value, **labels)  # type: ignore[attr-defined]
+    def record_histogram(self, name: str, value: float,
+                         exemplar: Optional[Dict[str, Any]] = None,
+                         **labels: str) -> None:
+        self._get(name, Histogram).record(value, exemplar=exemplar, **labels)  # type: ignore[attr-defined]
 
     def record_histogram_n(self, name: str, value: float, n: int,
+                           exemplar: Optional[Dict[str, Any]] = None,
                            **labels: str) -> None:
-        self._get(name, Histogram).record_n(value, n, **labels)  # type: ignore[attr-defined]
+        self._get(name, Histogram).record_n(value, n, exemplar=exemplar, **labels)  # type: ignore[attr-defined]
 
     # -- introspection -------------------------------------------------------
     def get(self, name: str) -> Optional[_Instrument]:
         return self._store.get(name)
 
-    def expose(self) -> str:
-        """Render the whole registry in Prometheus text exposition format."""
+    def expose(self, openmetrics: bool = False) -> str:
+        """Render the whole registry in Prometheus text exposition format.
+
+        openmetrics=True renders the OpenMetrics dialect instead: the same
+        lines plus per-bucket histogram exemplars and the terminating
+        `# EOF` marker — what a scrape negotiating
+        `Accept: application/openmetrics-text` gets. Classic output never
+        carries exemplars (Prometheus' text parser rejects them)."""
         lines: List[str] = []
         with self._lock:
             instruments = list(self._store.values())
         for inst in sorted(instruments, key=lambda i: i.name):
-            lines.extend(inst.expose())
+            lines.extend(inst.expose(openmetrics=openmetrics))
+        if openmetrics:
+            lines.append("# EOF")
         return "\n".join(lines) + "\n"
 
 

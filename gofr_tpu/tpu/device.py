@@ -134,6 +134,14 @@ class TPUClient:
              "already-delivered tokens re-prefilled by replay admissions"),
             ("app_tpu_requests_quarantined_total",
              "poison requests failed after repeatedly reset-looping the engine"),
+            # step anatomy ledger (tpu/stepledger.py)
+            ("app_tpu_step_stragglers_total",
+             "engine steps flagged slower than the rolling per-phase "
+             "baseline, by dominant-segment cause"),
+            # best-effort hook self-observability (tpu/obs.py)
+            ("app_obs_dropped_metrics_total",
+             "metric recordings swallowed by best-effort hooks, by metric "
+             "name (a non-zero series is a wiring bug)"),
         ):
             try:
                 m.new_counter(name, desc)
@@ -178,12 +186,17 @@ class TPUClient:
                 m.new_gauge(name, desc)
             except Exception:  # noqa: BLE001
                 pass
+        from .stepledger import STEP_SECONDS_BUCKETS
+
         for name, desc, buckets in (
             ("app_tpu_ttft_seconds", "time to first token", TTFT_BUCKETS),
             ("app_tpu_queue_wait_seconds", "submit-to-admission wait", TTFT_BUCKETS),
             ("app_tpu_tpot_seconds", "time per output token", TPOT_BUCKETS),
             ("app_tpu_batch_size", "assembled batch sizes", BATCH_BUCKETS),
             ("app_tpu_execute_seconds", "device execution wall time", TPOT_BUCKETS),
+            ("app_tpu_step_seconds",
+             "engine step time by phase and attributed segment",
+             STEP_SECONDS_BUCKETS),
         ):
             try:
                 m.new_histogram(name, desc, buckets)

@@ -51,6 +51,7 @@ from ..models.llama import (LlamaConfig, init_kv_cache_layers,
 from .executor import Executor, next_bucket
 from .obs import MetricsHook
 from .sampling import pack_controls, sample_tokens, temperature_of
+from .stepledger import StepLedger
 from .utilization import UtilizationLedger
 
 
@@ -152,7 +153,12 @@ class GenerationRequest:
         self.out_queue: "queue.Queue" = queue.Queue()
         self.cancelled = threading.Event()
         self.error: Optional[BaseException] = None
-        self.enqueued_at = time.time()
+        # ALL lifecycle stamps are time.monotonic(): queue-wait, TTFT, SLO
+        # and step math are interval arithmetic, and an NTP step mid-flight
+        # must not corrupt them. Wall-clock appears only where timestamps
+        # leave the process (flight-recorder display, synthesized spans —
+        # the recorder anchors a wall/monotonic pair per request)
+        self.enqueued_at = time.monotonic()
         self.admitted_at: Optional[float] = None   # prefill dispatch time
         self.first_token_at: Optional[float] = None
         self.finished_at: Optional[float] = None
@@ -558,7 +564,7 @@ class LLMEngine:
         # between the engine loop and boot-time warmup() on the caller thread
         self._state_lock = threading.Lock()
         self._jnp = jnp
-        self._obs = MetricsHook(self.metrics)
+        self._obs = MetricsHook(self.metrics, logger=logger)
         # utilization ledger (tpu/utilization.py): always-on roofline
         # accounting — pure host arithmetic, O(1) per dispatch sync, fed
         # from _sync_oldest and the loop's host-time stamps
@@ -566,6 +572,11 @@ class LLMEngine:
             cfg, metrics=self.metrics,
             n_devices=mesh.size if mesh is not None else 1,
             params_nbytes=params_nbytes(self.params))
+        # step anatomy ledger (tpu/stepledger.py): always-on per-iteration
+        # wall-clock attribution + straggler sentinel — loop-thread-only
+        # accumulation, a handful of monotonic() reads per step
+        self.steps = StepLedger(metrics=self.metrics, logger=logger)
+        self.executor.on_compile = self._note_compile
         self.tracer = tracer
         # per-request flight recorder (tpu/flightrecorder.py): best-effort
         # like MetricsHook — every hook below is None-guarded and O(1), so
@@ -725,21 +736,25 @@ class LLMEngine:
                     tuple(jnp.pad(s, spad) for s in vs_layers))
 
         try:
-            if self.faults is not None:
-                self.faults.hit("engine.cache_grow")
-            if self._q8:
-                program = self.executor.compile(
-                    f"kv-grow-q8-{self._cache_len}-to-{new_len}", grow_fn_q8,
-                    (self.k_cache, self.v_cache, self.k_scale, self.v_scale),
-                    donate_argnums=(0, 1, 2, 3))
-                (self.k_cache, self.v_cache, self.k_scale,
-                 self.v_scale) = program(self.k_cache, self.v_cache,
-                                         self.k_scale, self.v_scale)
-            else:
-                program = self.executor.compile(
-                    f"kv-grow-{self._cache_len}-to-{new_len}", grow_fn,
-                    (self.k_cache, self.v_cache), donate_argnums=(0, 1))
-                self.k_cache, self.v_cache = program(self.k_cache, self.v_cache)
+            with self.steps.seg("cache_grow"):
+                if self.faults is not None:
+                    self.faults.hit("engine.cache_grow")
+                if self._q8:
+                    program = self.executor.compile(
+                        f"kv-grow-q8-{self._cache_len}-to-{new_len}",
+                        grow_fn_q8,
+                        (self.k_cache, self.v_cache, self.k_scale,
+                         self.v_scale),
+                        donate_argnums=(0, 1, 2, 3))
+                    (self.k_cache, self.v_cache, self.k_scale,
+                     self.v_scale) = program(self.k_cache, self.v_cache,
+                                             self.k_scale, self.v_scale)
+                else:
+                    program = self.executor.compile(
+                        f"kv-grow-{self._cache_len}-to-{new_len}", grow_fn,
+                        (self.k_cache, self.v_cache), donate_argnums=(0, 1))
+                    self.k_cache, self.v_cache = program(self.k_cache,
+                                                         self.v_cache)
         except Exception as exc:
             # the grow program consumed the donated caches: this is a
             # device-state loss, not a host-prep failure — _admit's per-wave
@@ -976,8 +991,8 @@ class LLMEngine:
         _drain_pending here would race _admit's own pop loop."""
         self._draining = True
         self._wake.set()
-        deadline = time.time() + timeout_s
-        while time.time() < deadline:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
             # under _state_lock: an admission wave mid-flight holds the lock
             # between popping _pending and binding slots — an unlocked poll
             # could observe that window as "idle" and green-light stop()
@@ -1330,17 +1345,18 @@ class LLMEngine:
         jnp = self._jnp
         if bucket + 1 > self._cache_len:
             self._grow_cache(bucket + 1)
-        ptokens, lengths, new_temps = self._prep_admission(bucket, batch)
-        job = {
-            "batch": batch, "slots_idx": slots_idx, "bucket": bucket,
-            "chunk": self.chunk_prefill_tokens, "next_start": 0,
-            "ptokens": np.asarray(ptokens), "lengths": lengths,
-            "new_temps": new_temps,
-            "selected": jnp.zeros((len(batch), self.cfg.vocab_size),
-                                  dtype=jnp.float32),
-        }
+        with self.steps.seg("host_prep"):
+            ptokens, lengths, new_temps = self._prep_admission(bucket, batch)
+            job = {
+                "batch": batch, "slots_idx": slots_idx, "bucket": bucket,
+                "chunk": self.chunk_prefill_tokens, "next_start": 0,
+                "ptokens": np.asarray(ptokens), "lengths": lengths,
+                "new_temps": new_temps,
+                "selected": jnp.zeros((len(batch), self.cfg.vocab_size),
+                                      dtype=jnp.float32),
+            }
         self._dispatch_chunk(job)  # chunk 1 parks the positions
-        now = time.time()
+        now = time.monotonic()
         for row, request in enumerate(batch):
             request.admitted_at = now
             self._obs.hist("app_tpu_queue_wait_seconds",
@@ -1383,31 +1399,36 @@ class LLMEngine:
             (K, chunk))
         program = self._chunk_program(chunk, K, first=(start == 0),
                                       final=final)
+        self.steps.note_dispatch("chunk")
         try:
-            if self.faults is not None:
-                self.faults.hit("engine.chunk")
-            if self._q8:
-                (self.k_cache, self.v_cache, self.k_scale, self.v_scale,
-                 job["selected"], self._tokens, self._positions, self._temps,
-                 self.rng, first_tok) = program(
-                    self.params, self.k_cache, self.v_cache, self.k_scale,
-                    self.v_scale, jnp.asarray(ctokens),
-                    jnp.asarray(cpositions),
-                    jnp.asarray(np.asarray(job["slots_idx"], dtype=np.int32)),
-                    jnp.asarray(job["lengths"]),
-                    jnp.asarray(start, dtype=jnp.int32), job["selected"],
-                    self._tokens, self._positions, self._temps,
-                    jnp.asarray(job["new_temps"]), self.rng)
-            else:
-                (self.k_cache, self.v_cache, job["selected"], self._tokens,
-                 self._positions, self._temps, self.rng, first_tok) = program(
-                    self.params, self.k_cache, self.v_cache,
-                    jnp.asarray(ctokens), jnp.asarray(cpositions),
-                    jnp.asarray(np.asarray(job["slots_idx"], dtype=np.int32)),
-                    jnp.asarray(job["lengths"]),
-                    jnp.asarray(start, dtype=jnp.int32), job["selected"],
-                    self._tokens, self._positions, self._temps,
-                    jnp.asarray(job["new_temps"]), self.rng)
+            with self.steps.seg("dispatch"):
+                if self.faults is not None:
+                    self.faults.hit("engine.chunk")
+                if self._q8:
+                    (self.k_cache, self.v_cache, self.k_scale, self.v_scale,
+                     job["selected"], self._tokens, self._positions,
+                     self._temps, self.rng, first_tok) = program(
+                        self.params, self.k_cache, self.v_cache, self.k_scale,
+                        self.v_scale, jnp.asarray(ctokens),
+                        jnp.asarray(cpositions),
+                        jnp.asarray(np.asarray(job["slots_idx"],
+                                               dtype=np.int32)),
+                        jnp.asarray(job["lengths"]),
+                        jnp.asarray(start, dtype=jnp.int32), job["selected"],
+                        self._tokens, self._positions, self._temps,
+                        jnp.asarray(job["new_temps"]), self.rng)
+                else:
+                    (self.k_cache, self.v_cache, job["selected"],
+                     self._tokens, self._positions, self._temps, self.rng,
+                     first_tok) = program(
+                        self.params, self.k_cache, self.v_cache,
+                        jnp.asarray(ctokens), jnp.asarray(cpositions),
+                        jnp.asarray(np.asarray(job["slots_idx"],
+                                               dtype=np.int32)),
+                        jnp.asarray(job["lengths"]),
+                        jnp.asarray(start, dtype=jnp.int32), job["selected"],
+                        self._tokens, self._positions, self._temps,
+                        jnp.asarray(job["new_temps"]), self.rng)
         except Exception as exc:
             raise CacheLostError(f"chunk prefill dispatch failed: {exc}") from exc
         job["next_start"] = start + chunk
@@ -1565,23 +1586,25 @@ class LLMEngine:
         drafts = np.zeros((self.n_slots, d), dtype=np.int32)
         lens = np.zeros((self.n_slots,), dtype=np.int32)
         snapshot = []
-        for i, slot in enumerate(self.slots):
-            if not slot.active:
-                continue
-            # greedy rows only (acceptance is exact-match against argmax);
-            # a temperature row rides the dispatch as a plain 1-token step.
-            # Eligibility travels with the snapshot so the sync-side
-            # acceptance EMA divides by rows that COULD accept — a batch
-            # half full of temperature traffic must not read as 50%
-            # rejection and cool speculation off for the greedy half
-            eligible = bool(slot.request.temperature <= 0.0 and slot.history
-                            and slot.remaining > 0)
-            snapshot.append((i, slot.request, eligible))
-            if eligible:
-                cont = self._propose_draft(slot.history)
-                if cont:
-                    drafts[i, :len(cont)] = cont
-                    lens[i] = len(cont)
+        with self.steps.seg("host_prep"):
+            for i, slot in enumerate(self.slots):
+                if not slot.active:
+                    continue
+                # greedy rows only (acceptance is exact-match against
+                # argmax); a temperature row rides the dispatch as a plain
+                # 1-token step. Eligibility travels with the snapshot so
+                # the sync-side acceptance EMA divides by rows that COULD
+                # accept — a batch half full of temperature traffic must
+                # not read as 50% rejection and cool speculation off for
+                # the greedy half
+                eligible = bool(slot.request.temperature <= 0.0
+                                and slot.history and slot.remaining > 0)
+                snapshot.append((i, slot.request, eligible))
+                if eligible:
+                    cont = self._propose_draft(slot.history)
+                    if cont:
+                        drafts[i, :len(cont)] = cont
+                        lens[i] = len(cont)
         if lens.sum() == 0:
             # nothing to verify (all-temperature batch, or the proposer
             # found no continuations): a verify dispatch would be a plain
@@ -1596,12 +1619,14 @@ class LLMEngine:
             self._dispatch_decode()
             return
         self._spec_no_draft_streak = 0
-        start = time.time()
+        self.steps.note_dispatch("verify")
+        start = time.monotonic()
         try:
-            if self.faults is not None:
-                self.faults.hit("engine.verify")
-            out_tokens, n_emit = self._verify_call(jnp.asarray(drafts),
-                                                   jnp.asarray(lens))
+            with self.steps.seg("dispatch"):
+                if self.faults is not None:
+                    self.faults.hit("engine.verify")
+                out_tokens, n_emit = self._verify_call(jnp.asarray(drafts),
+                                                       jnp.asarray(lens))
         except Exception as exc:
             raise CacheLostError(f"verify dispatch failed: {exc}") from exc
         self._obs.counter("app_tpu_spec_drafted_total", float(lens.sum()))
@@ -1664,11 +1689,14 @@ class LLMEngine:
         while not self._stop.is_set():
             self._last_step_at = time.monotonic()
             try:
-                host_t0 = time.time()
+                steps = self.steps
+                steps.step_start()
+                host_t0 = time.monotonic()
                 if self.breaker.probe_due():
                     self._breaker_probe()
                 with self._state_lock:
-                    self._admit()
+                    with steps.seg("admission"):
+                        self._admit()
                     # one chunk per iteration: decode dispatches below and
                     # the next iteration's admissions interleave with a
                     # long prompt's remaining chunks
@@ -1701,15 +1729,24 @@ class LLMEngine:
                 # scheduler/prep/enqueue time this iteration (the state-lock
                 # block never blocks on the device — syncs happen below).
                 # Sub-millisecond idle iterations are noise, not overhead
-                host_s = time.time() - host_t0
+                host_s = time.monotonic() - host_t0
                 if host_s >= 1e-3:
                     self.util.note_host(host_s)
+                synced = False
                 if self._inflight:
-                    self._sync_oldest()
-                elif not self._chunk_jobs:
+                    with steps.seg("emit"):
+                        self._sync_oldest()
+                    synced = True
+                # close the step BEFORE any idle park below: the wait time
+                # belongs to the NEXT step's idle_gap, not this step's wall
+                self._finish_step()
+                if not synced and not self._chunk_jobs \
+                        and not self._inflight:
                     self._wake.wait(timeout=0.05)
                     self._wake.clear()
             except Exception as exc:  # noqa: BLE001 - fail active requests, keep serving
+                # a step that died mid-flight must not feed the baselines
+                self.steps.step_abort()
                 if self.logger is not None:
                     self.logger.errorf("engine step failed: %s", exc)
                 self._reset_device_state(exc)
@@ -1727,6 +1764,28 @@ class LLMEngine:
             if slot.active:
                 slot.request.error = stop_exc
                 self._finish_slot(slot)
+
+    def _note_compile(self, name: str, seconds: float) -> None:
+        """Executor cache-miss callback: re-attribute compile time out of
+        whatever step segment it elapsed under (tpu/stepledger.py). A
+        foreign-thread compile (warmup, scoring) is ignored by the ledger's
+        thread guard."""
+        self.steps.note_stolen("compile", seconds)
+
+    def _finish_step(self) -> None:
+        """Close the step ledger's iteration record and surface a flagged
+        straggler as a flight-recorder engine event carrying the dominant
+        segment as the cause — the metrics→trace→request drill's anchor."""
+        rec = self.steps.step_end(
+            active_slots=sum(1 for s in self.slots if s.active),
+            inflight=len(self._inflight),
+            queue_depth=self._pending.qsize())
+        if rec is not None and rec.straggler and self.recorder is not None:
+            self.recorder.record_engine_event(
+                "step_straggler", step=rec.seq, phase=rec.phase,
+                wall_s=round(rec.wall_s, 6), cause=rec.cause,
+                baseline_s=round(rec.baseline_s or 0.0, 6),
+                request_id=rec.slowest_request_id)
 
     def _breaker_probe(self) -> None:
         """The reset-storm breaker's half-open probe: ONE tiny device
@@ -1980,7 +2039,7 @@ class LLMEngine:
         fused dispatch this request rode in), tpu.slot, tpu.prefill_bucket.
         """
         admitted = []
-        now = time.time()
+        now = time.monotonic()
         for row, request in enumerate(batch):
             if request.admitted_at is None:  # chunk jobs stamped at chunk 1
                 request.admitted_at = now
@@ -2015,8 +2074,9 @@ class LLMEngine:
             admitted.append((slots_idx[row], request))
         # the trailing timestamp is the dispatch-enqueue time the
         # utilization ledger unions into the device-busy window at sync
+        # (monotonic, like every util/step stamp)
         self._inflight.append(("prefill", first, admitted, dspan,
-                               time.time()))
+                               time.monotonic()))
 
     def _dispatch_prefill(self, bucket: int,
                           slots_idx: List[int],
@@ -2025,31 +2085,34 @@ class LLMEngine:
 
         K = len(batch)
         jnp = self._jnp
-        ptokens, lengths, new_temps = self._prep_admission(bucket, batch)
+        with self.steps.seg("host_prep"):
+            ptokens, lengths, new_temps = self._prep_admission(bucket, batch)
 
         if bucket + 1 > self._cache_len:  # prompts must land inside the cache
             self._grow_cache(bucket + 1)
         program = self._prefill_program(bucket, K)
+        self.steps.note_dispatch("prefill")
         try:
-            if self.faults is not None:
-                self.faults.hit("engine.prefill")
-            if self._q8:
-                (self.k_cache, self.v_cache, self.k_scale, self.v_scale,
-                 self._tokens, self._positions, self._temps, self.rng,
-                 first) = program(
-                    self.params, self.k_cache, self.v_cache, self.k_scale,
-                    self.v_scale, jnp.asarray(ptokens),
-                    jnp.asarray(np.asarray(slots_idx, dtype=np.int32)),
-                    jnp.asarray(lengths), self._tokens, self._positions,
-                    self._temps, jnp.asarray(new_temps), self.rng)
-            else:
-                (self.k_cache, self.v_cache, self._tokens, self._positions,
-                 self._temps, self.rng, first) = program(
-                    self.params, self.k_cache, self.v_cache,
-                    jnp.asarray(ptokens),
-                    jnp.asarray(np.asarray(slots_idx, dtype=np.int32)),
-                    jnp.asarray(lengths), self._tokens, self._positions,
-                    self._temps, jnp.asarray(new_temps), self.rng)
+            with self.steps.seg("dispatch"):
+                if self.faults is not None:
+                    self.faults.hit("engine.prefill")
+                if self._q8:
+                    (self.k_cache, self.v_cache, self.k_scale, self.v_scale,
+                     self._tokens, self._positions, self._temps, self.rng,
+                     first) = program(
+                        self.params, self.k_cache, self.v_cache, self.k_scale,
+                        self.v_scale, jnp.asarray(ptokens),
+                        jnp.asarray(np.asarray(slots_idx, dtype=np.int32)),
+                        jnp.asarray(lengths), self._tokens, self._positions,
+                        self._temps, jnp.asarray(new_temps), self.rng)
+                else:
+                    (self.k_cache, self.v_cache, self._tokens,
+                     self._positions, self._temps, self.rng, first) = program(
+                        self.params, self.k_cache, self.v_cache,
+                        jnp.asarray(ptokens),
+                        jnp.asarray(np.asarray(slots_idx, dtype=np.int32)),
+                        jnp.asarray(lengths), self._tokens, self._positions,
+                        self._temps, jnp.asarray(new_temps), self.rng)
         except Exception as exc:
             raise CacheLostError(f"prefill dispatch failed: {exc}") from exc
 
@@ -2088,21 +2151,23 @@ class LLMEngine:
         program = self._decode_program(block)
         snapshot = [(i, slot.request) for i, slot in enumerate(self.slots)
                     if slot.active]
-        start = time.time()
+        self.steps.note_dispatch("decode")
+        start = time.monotonic()
         try:
-            if self.faults is not None:
-                self.faults.hit("engine.decode")
-            if self._q8:
-                (self.k_cache, self.v_cache, self.k_scale, self.v_scale,
-                 self._tokens, self._positions, self.rng, out_tokens) = \
-                    program(self.params, self.k_cache, self.v_cache,
-                            self.k_scale, self.v_scale, self._tokens,
-                            self._positions, self._temps, self.rng)
-            else:
-                (self.k_cache, self.v_cache, self._tokens, self._positions,
-                 self.rng, out_tokens) = program(
-                    self.params, self.k_cache, self.v_cache,
-                    self._tokens, self._positions, self._temps, self.rng)
+            with self.steps.seg("dispatch"):
+                if self.faults is not None:
+                    self.faults.hit("engine.decode")
+                if self._q8:
+                    (self.k_cache, self.v_cache, self.k_scale, self.v_scale,
+                     self._tokens, self._positions, self.rng, out_tokens) = \
+                        program(self.params, self.k_cache, self.v_cache,
+                                self.k_scale, self.v_scale, self._tokens,
+                                self._positions, self._temps, self.rng)
+                else:
+                    (self.k_cache, self.v_cache, self._tokens,
+                     self._positions, self.rng, out_tokens) = program(
+                        self.params, self.k_cache, self.v_cache,
+                        self._tokens, self._positions, self._temps, self.rng)
         except Exception as exc:
             raise CacheLostError(f"decode dispatch failed: {exc}") from exc
         dspan = self._dispatch_span("tpu.decode", next(self._batch_seq),
@@ -2111,19 +2176,32 @@ class LLMEngine:
         self._inflight.append(("decode", out_tokens, snapshot,
                                block, start, dspan))
 
+    def _exemplar_of(self, request) -> Dict[str, str]:
+        """Histogram exemplar labels for a request: the deep-link payload
+        carried into OpenMetrics exposition (request id resolves via
+        /debug/requests/{id}; trace id via the configured trace backend)."""
+        ex = {"request_id": str(request.id)}
+        span = request.gen_span or request.span
+        trace_id = getattr(span, "trace_id", None)
+        if trace_id:
+            ex["trace_id"] = trace_id
+        return ex
+
     def _sync_oldest(self) -> None:
         import numpy as np
 
-        if self.faults is not None:
-            # sync-site chaos: latency (delay rules) or a simulated PJRT
-            # failure (raise rules) at the host sync point
-            self.faults.hit("engine.sync")
+        with self.steps.seg("device_sync"):
+            if self.faults is not None:
+                # sync-site chaos: latency (delay rules) or a simulated PJRT
+                # failure (raise rules) at the host sync point
+                self.faults.hit("engine.sync")
         entry = self._inflight.popleft()
         if entry[0] == "prefill":
             _, first, admitted, dspan, dispatched_at = entry
-            sync_t0 = time.time()
+            sync_t0 = time.monotonic()
             try:
-                first_host = np.asarray(first)  # blocks until the device got there
+                with self.steps.seg("device_sync"):
+                    first_host = np.asarray(first)  # blocks until the device got there
             except Exception as exc:
                 if dspan is not None:
                     dspan.set_status(False, str(exc))
@@ -2131,11 +2209,19 @@ class LLMEngine:
                 raise CacheLostError(f"prefill execution failed: {exc}") from exc
             if dspan is not None:
                 dspan.end()
-            now = time.time()
+            now = time.monotonic()
             self.util.record_prefill(
                 tokens=sum(len(r.resume_tokens) for _, r in admitted),
                 dispatched_at=dispatched_at, synced_at=now,
                 sync_wait_s=now - sync_t0)
+            # the step's cost driver: the widest admission window in the
+            # fused dispatch (prefill cost tracks the bucket its longest
+            # prompt selected)
+            slowest = max(admitted, key=lambda e: len(e[1].resume_tokens),
+                          default=(None, None))[1]
+            self.steps.note_sync(
+                "prefill", tokens=len(admitted),
+                slowest_request_id=slowest.id if slowest else None)
             for row, (slot_idx, request) in enumerate(admitted):
                 slot = self.slots[slot_idx]
                 if slot.request is not request:  # cancelled between dispatch+sync
@@ -2148,7 +2234,8 @@ class LLMEngine:
                     if self.recorder is not None:
                         self.recorder.record_first_token(request)
                     self._obs.hist("app_tpu_ttft_seconds",
-                                   now - request.enqueued_at)
+                                   now - request.enqueued_at,
+                                   exemplar=self._exemplar_of(request))
                 token = int(first_host[row])
                 if self.speculative_tokens:
                     # resume_tokens read BEFORE the emit below appends
@@ -2162,10 +2249,11 @@ class LLMEngine:
         if entry[0] == "verify":
             _, fut, snapshot, d, started, dspan = entry
             out_dev, n_emit_dev = fut
-            sync_t0 = time.time()
+            sync_t0 = time.monotonic()
             try:
-                out_host = np.asarray(out_dev)             # [B, d+1]
-                n_emit_host = np.asarray(n_emit_dev)       # [B]
+                with self.steps.seg("device_sync"):
+                    out_host = np.asarray(out_dev)         # [B, d+1]
+                    n_emit_host = np.asarray(n_emit_dev)   # [B]
             except Exception as exc:
                 if dspan is not None:
                     dspan.set_status(False, str(exc))
@@ -2173,16 +2261,20 @@ class LLMEngine:
                 raise CacheLostError(f"verify execution failed: {exc}") from exc
             if dspan is not None:
                 dspan.end()
-            synced = time.time()
+            synced = time.monotonic()
             elapsed = synced - started
             # a verify scores d+1 positions per row; slot lengths are read
             # BEFORE the demux advances them, i.e. the dispatched context
+            live = [(i, r) for i, r, _ in snapshot
+                    if self.slots[i].request is r]
             self.util.record_decode(
                 rows=len(snapshot), steps=d + 1,
-                kv_tokens=sum(self.slots[i].length for i, r, _ in snapshot
-                              if self.slots[i].request is r),
+                kv_tokens=sum(self.slots[i].length for i, r in live),
                 dispatched_at=started, synced_at=synced,
                 sync_wait_s=synced - sync_t0)
+            # pre-demux deepest context: the lock-step batch's cost driver
+            slowest = max(live, key=lambda e: self.slots[e[0]].length,
+                          default=(None, None))[1]
             self._obs.hist("app_tpu_execute_seconds", elapsed)
             emitted = n_active = n_eligible = device_accepted = 0
             for slot_idx, request, eligible in snapshot:
@@ -2222,10 +2314,15 @@ class LLMEngine:
                     self._finish_slot(slot)
             # every token in this sync shares one dispatch wall time; the
             # per-token cost is elapsed / (avg tokens per active slot)
+            self.steps.note_sync(
+                "verify", tokens=emitted,
+                slowest_request_id=slowest.id if slowest else None)
             if emitted:
                 per_slot = emitted / max(1, n_active)
-                self._obs.hist_n("app_tpu_tpot_seconds", elapsed / per_slot,
-                                 emitted)
+                self._obs.hist_n(
+                    "app_tpu_tpot_seconds", elapsed / per_slot, emitted,
+                    exemplar=(self._exemplar_of(slowest) if slowest
+                              else None))
             self._obs.hist("app_tpu_batch_size", n_active)
             self._track_throughput(emitted)
             # adaptive speculation: fold this dispatch's accepted-per-
@@ -2244,9 +2341,10 @@ class LLMEngine:
             return
 
         _, out_tokens, snapshot, block, started, dspan = entry
-        sync_t0 = time.time()
+        sync_t0 = time.monotonic()
         try:
-            tokens_host = np.asarray(out_tokens)  # [B, block]; device sync point
+            with self.steps.seg("device_sync"):
+                tokens_host = np.asarray(out_tokens)  # [B, block]; device sync point
         except Exception as exc:
             if dspan is not None:
                 dspan.set_status(False, str(exc))
@@ -2254,17 +2352,20 @@ class LLMEngine:
             raise CacheLostError(f"decode execution failed: {exc}") from exc
         if dspan is not None:
             dspan.end()
-        synced = time.time()
+        synced = time.monotonic()
         step_s = (synced - started) / block
         self._obs.hist("app_tpu_execute_seconds", synced - started)
         # slot lengths are pre-demux here: the live context this dispatch
         # actually read each step (the MBU KV term)
+        live = [(i, r) for i, r in snapshot if self.slots[i].request is r]
         self.util.record_decode(
             rows=len(snapshot), steps=block,
-            kv_tokens=sum(self.slots[i].length for i, r in snapshot
-                          if self.slots[i].request is r),
+            kv_tokens=sum(self.slots[i].length for i, r in live),
             dispatched_at=started, synced_at=synced,
             sync_wait_s=synced - sync_t0)
+        # pre-demux deepest context: the lock-step batch's cost driver
+        slowest = max(live, key=lambda e: self.slots[e[0]].length,
+                      default=(None, None))[1]
 
         n_active = 0
         emitted = 0
@@ -2300,7 +2401,12 @@ class LLMEngine:
                 self._finish_slot(slot)
         # every token in this sync shares one measured step time: record the
         # TPOT histogram ONCE per sync, not per token (VERDICT r2 weak #9)
-        self._obs.hist_n("app_tpu_tpot_seconds", step_s, emitted)
+        self.steps.note_sync(
+            "decode", tokens=emitted,
+            slowest_request_id=slowest.id if slowest else None)
+        self._obs.hist_n(
+            "app_tpu_tpot_seconds", step_s, emitted,
+            exemplar=(self._exemplar_of(slowest) if slowest else None))
         self._obs.hist("app_tpu_batch_size", n_active)
         self._track_throughput(emitted)
 
@@ -2311,8 +2417,8 @@ class LLMEngine:
         if exc is not None:
             request.error = exc
         if request.finished_at is None:  # terminal either way: consumers
-            request.finished_at = time.time()  # and the admission plane's
-            # live-registry prune both treat this request as over
+            request.finished_at = time.monotonic()  # and the admission
+            # plane's live-registry prune both treat this request as over
         if request.gen_span is not None and request.gen_span.end_time is None:
             if request.error is not None:
                 request.gen_span.set_status(False, str(request.error))
@@ -2362,7 +2468,7 @@ class LLMEngine:
             if idx is not None:
                 self._temps = self._temps.at[idx].set(0.0)
         if request is not None:
-            request.finished_at = time.time()
+            request.finished_at = time.monotonic()
             if request.gen_span is not None:
                 request.gen_span.set_attribute("tpu.tokens", request.generated)
                 if request.error is not None:
@@ -2526,7 +2632,7 @@ class LLMEngine:
             self._fail_request(request, exc)
 
     def _track_throughput(self, tokens: int) -> None:
-        now = time.time()
+        now = time.monotonic()
         self._tok_window.append((now, tokens))
         cutoff = now - 5.0
         while self._tok_window and self._tok_window[0][0] < cutoff:

@@ -92,6 +92,13 @@ class Executor:
         # fault-injection plane (tpu/faults.py): None in production; armed
         # deployments can add latency to (or fail) compile lookups
         self.faults = None
+        # step-ledger attribution (tpu/stepledger.py): called with
+        # (name, seconds) after every cache-MISS compile so the engine can
+        # re-attribute compile time out of the segment it happened under.
+        # One callback per executor — an executor shared across engines
+        # reports to whichever engine bound it last (attribution only;
+        # correctness never depends on it)
+        self.on_compile = None
         # compiled-program persistence (SURVEY §2.5 item 2): serialized PJRT
         # executables keyed by (program, shapes, backend); a second boot
         # loads them instead of re-tracing + re-compiling
@@ -367,6 +374,11 @@ class Executor:
             # a racing thread may have compiled the same key; keep the first
             program = self._cache.setdefault(key, program)
         self._observe_compile(name, elapsed, hit=False)
+        if self.on_compile is not None:
+            try:
+                self.on_compile(name, elapsed)
+            except Exception:  # noqa: BLE001 - attribution is best-effort
+                pass
         return program
 
     def run(self, name: str, fn: Callable, *args, **compile_kwargs):

@@ -49,13 +49,19 @@ DEFAULT_TPOT_TARGET_S = 0.050
 
 
 class RequestRecord:
-    """One request's lifecycle: identity, phase stamps, bounded events."""
+    """One request's lifecycle: identity, phase stamps, bounded events.
+
+    Clock discipline: every stamp is ``time.monotonic()`` (the engine's
+    clock domain — NTP can never corrupt the interval math), plus ONE
+    wall/monotonic anchor pair captured at enqueue. Epoch timestamps are
+    derived through the anchor only where they leave the process: the
+    summary/detail display and synthesized spans."""
 
     __slots__ = ("id", "prompt_tokens", "max_new_tokens", "priority",
                  "trace_id", "parent_span_id", "enqueued_at", "admitted_at",
                  "first_token_at", "finished_at", "generated", "outcome",
                  "error", "slot", "bucket", "batch_id", "chunked",
-                 "events", "events_dropped")
+                 "events", "events_dropped", "wall0", "mono0")
 
     def __init__(self, request) -> None:
         self.id = request.id
@@ -65,6 +71,10 @@ class RequestRecord:
         self.trace_id: Optional[str] = None
         self.parent_span_id: Optional[str] = None
         self.enqueued_at = request.enqueued_at
+        # wall/monotonic anchor: the ONE place both clocks are read
+        # together; every displayed epoch is enqueue-wall + monotonic delta
+        self.wall0 = time.time()
+        self.mono0 = time.monotonic()
         self.admitted_at: Optional[float] = None
         self.first_token_at: Optional[float] = None
         self.finished_at: Optional[float] = None
@@ -78,12 +88,17 @@ class RequestRecord:
         self.events: List[tuple] = [(self.enqueued_at, "enqueued", None)]
         self.events_dropped = 0
 
+    def wall(self, t_mono: float) -> float:
+        """Epoch rendering of a monotonic stamp through the anchor."""
+        return self.wall0 + (t_mono - self.mono0)
+
     def add_event(self, name: str, data: Optional[Dict[str, Any]],
                   cap: int, t: Optional[float] = None) -> None:
         if len(self.events) >= cap:
             self.events_dropped += 1
             return
-        self.events.append((t if t is not None else time.time(), name, data))
+        self.events.append((t if t is not None else time.monotonic(),
+                            name, data))
 
     def has_event(self, name: str) -> bool:
         return any(e[1] == name for e in self.events)
@@ -125,7 +140,8 @@ class RequestRecord:
             "prompt_tokens": self.prompt_tokens,
             "max_new_tokens": self.max_new_tokens,
             "generated": self.generated,
-            "enqueued_at": self.enqueued_at,
+            # displayed as epoch via the anchor (stored stamp is monotonic)
+            "enqueued_at": round(self.wall(self.enqueued_at), 6),
             "phases": self.phases(),
         }
         for key in ("outcome", "error", "slot", "bucket", "batch_id",
@@ -148,7 +164,7 @@ class RequestRecord:
     def detail(self) -> Dict[str, Any]:
         out = self.summary()
         out["events"] = [
-            {"t": t, "event": name, **(data or {})}
+            {"t": round(self.wall(t), 6), "event": name, **(data or {})}
             for t, name, data in self.events
         ]
         if self.events_dropped:
@@ -236,7 +252,7 @@ class FlightRecorder:
                     rec.batch_id = batch_id
                 if rec.admitted_at is not None:
                     return  # chunk path: admitted at chunk 1, bound later
-                rec.admitted_at = request.admitted_at or time.time()
+                rec.admitted_at = request.admitted_at or time.monotonic()
                 rec.slot = slot
                 rec.bucket = bucket
                 rec.chunked = chunked
@@ -262,7 +278,8 @@ class FlightRecorder:
                 rec = self._live.get(request.id)
                 if rec is None or rec.first_token_at is not None:
                     return
-                rec.first_token_at = request.first_token_at or time.time()
+                rec.first_token_at = (request.first_token_at
+                                      or time.monotonic())
                 rec.add_event("first_token", None, self.max_events,
                               t=rec.first_token_at)
         except Exception:  # noqa: BLE001
@@ -290,7 +307,7 @@ class FlightRecorder:
                 rec = self._live.pop(request.id, None)
                 if rec is None:
                     return
-                rec.finished_at = request.finished_at or time.time()
+                rec.finished_at = request.finished_at or time.monotonic()
                 rec.generated = request.generated
                 rec.outcome = reason
                 if request.error is not None:
@@ -327,31 +344,37 @@ class FlightRecorder:
         tracer = self.tracer
         if tracer is None or rec.trace_id is None:
             return
-        end = rec.finished_at or time.time()
+        # spans leave the process: render the monotonic stamps as epochs
+        # through the record's anchor (one linear shift, so phase
+        # boundaries stay exactly contiguous)
+        end = rec.wall(rec.finished_at if rec.finished_at is not None
+                       else time.monotonic())
         attrs = {"request.id": rec.id}
         if rec.batch_id is not None:
             attrs["batch.id"] = rec.batch_id
         if rec.slot is not None:
             attrs["tpu.slot"] = rec.slot
-        queue_end = rec.admitted_at if rec.admitted_at is not None else end
-        tracer.span_at("engine.queue", rec.enqueued_at, queue_end,
+        queue_end = (rec.wall(rec.admitted_at)
+                     if rec.admitted_at is not None else end)
+        tracer.span_at("engine.queue", rec.wall(rec.enqueued_at), queue_end,
                        trace_id=rec.trace_id, parent_id=rec.parent_span_id,
                        attributes=dict(attrs, outcome=rec.outcome or ""))
         if rec.admitted_at is None:
             return
-        prefill_end = (rec.first_token_at
+        prefill_end = (rec.wall(rec.first_token_at)
                        if rec.first_token_at is not None else end)
         pattrs = dict(attrs)
         if rec.bucket is not None:
             pattrs["tpu.prefill_bucket"] = rec.bucket
         if rec.chunked:
             pattrs["tpu.chunked"] = True
-        tracer.span_at("engine.prefill", rec.admitted_at, prefill_end,
+        tracer.span_at("engine.prefill", rec.wall(rec.admitted_at),
+                       prefill_end,
                        trace_id=rec.trace_id, parent_id=rec.parent_span_id,
                        attributes=pattrs)
         if rec.first_token_at is None:
             return
-        tracer.span_at("engine.decode", rec.first_token_at, end,
+        tracer.span_at("engine.decode", rec.wall(rec.first_token_at), end,
                        trace_id=rec.trace_id, parent_id=rec.parent_span_id,
                        attributes=dict(attrs, **{
                            "tpu.tokens": rec.generated,

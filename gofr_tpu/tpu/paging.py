@@ -235,6 +235,13 @@ class PagedLLMEngine(LLMEngine):
     def _admission_ready(self, request: GenerationRequest) -> bool:
         if request.id in self._reservations:
             return True
+        with self.steps.seg("page_alloc"):
+            return self._reserve_pages(request)
+
+    def _reserve_pages(self, request: GenerationRequest) -> bool:
+        """Page reservation + prefix match/eviction — the `page_alloc`
+        step segment (a pool under pressure shows up here, including the
+        page-wait retries an exhausted pool causes)."""
         shared: List[int] = []
         if self.prefix is not None:
             if request.id not in self._prefix_hits:
@@ -710,47 +717,48 @@ class PagedLLMEngine(LLMEngine):
         jnp = self._jnp
         from ..models.llama import _np_dtype
 
-        ptokens, lengths, new_temps = self._prep_admission(bucket, batch)
-        K = len(batch)
-        n_ptable = max(1, math.ceil(bucket / self.page_size))
-        ptable = np.zeros((K, n_ptable), dtype=np.int32)
-        for row, request in enumerate(batch):
-            pages = self._reservations.get(request.id)
-            if pages is None:  # direct submit path outside _admit (tests)
-                pages = self.allocator.alloc(self._request_pages(request))
-                if pages is None:
-                    raise RuntimeError("page pool exhausted at dispatch")
-                self._reservations[request.id] = pages
-            prompt_pages = pages[:n_ptable]
-            ptable[row, :len(prompt_pages)] = prompt_pages
-        Hkv, dh = self.cfg.n_kv_heads, self.cfg.head_dim
-        dt = _np_dtype(self.cfg.dtype)
-        tmp_shape = (K, Hkv, dh, bucket)
+        with self.steps.seg("host_prep"):
+            ptokens, lengths, new_temps = self._prep_admission(bucket, batch)
+            K = len(batch)
+            n_ptable = max(1, math.ceil(bucket / self.page_size))
+            ptable = np.zeros((K, n_ptable), dtype=np.int32)
+            for row, request in enumerate(batch):
+                pages = self._reservations.get(request.id)
+                if pages is None:  # direct submit path outside _admit (tests)
+                    pages = self.allocator.alloc(self._request_pages(request))
+                    if pages is None:
+                        raise RuntimeError("page pool exhausted at dispatch")
+                    self._reservations[request.id] = pages
+                prompt_pages = pages[:n_ptable]
+                ptable[row, :len(prompt_pages)] = prompt_pages
+            Hkv, dh = self.cfg.n_kv_heads, self.cfg.head_dim
+            dt = _np_dtype(self.cfg.dtype)
+            tmp_shape = (K, Hkv, dh, bucket)
 
-        def temp():
-            t = tuple(jnp.zeros(tmp_shape, dtype=dt)
-                      for _ in range(self.cfg.n_layers))
-            if self.mesh is not None:
-                import jax
-                from jax.sharding import NamedSharding
+            def temp():
+                t = tuple(jnp.zeros(tmp_shape, dtype=dt)
+                          for _ in range(self.cfg.n_layers))
+                if self.mesh is not None:
+                    import jax
+                    from jax.sharding import NamedSharding
 
-                from ..parallel.sharding import kv_cache_layer_spec
+                    from ..parallel.sharding import kv_cache_layer_spec
 
-                s = NamedSharding(self.mesh, kv_cache_layer_spec())
-                t = tuple(jax.device_put(b, s) for b in t)
-            return t
+                    s = NamedSharding(self.mesh, kv_cache_layer_spec())
+                    t = tuple(jax.device_put(b, s) for b in t)
+                return t
 
-        job = {
-            "batch": batch, "slots_idx": slots_idx, "bucket": bucket,
-            "chunk": self.chunk_prefill_tokens, "next_start": 0,
-            "ptokens": np.asarray(ptokens), "lengths": lengths,
-            "new_temps": new_temps, "ptable": ptable,
-            "tmp_k": temp(), "tmp_v": temp(),
-            "selected": jnp.zeros((K, self.cfg.vocab_size),
-                                  dtype=jnp.float32),
-        }
+            job = {
+                "batch": batch, "slots_idx": slots_idx, "bucket": bucket,
+                "chunk": self.chunk_prefill_tokens, "next_start": 0,
+                "ptokens": np.asarray(ptokens), "lengths": lengths,
+                "new_temps": new_temps, "ptable": ptable,
+                "tmp_k": temp(), "tmp_v": temp(),
+                "selected": jnp.zeros((K, self.cfg.vocab_size),
+                                      dtype=jnp.float32),
+            }
         self._dispatch_chunk(job)
-        now = _time.time()
+        now = _time.monotonic()
         for row, request in enumerate(batch):
             request.admitted_at = now
             self._obs.hist("app_tpu_queue_wait_seconds",
@@ -770,42 +778,45 @@ class PagedLLMEngine(LLMEngine):
             np.arange(start, start + chunk, dtype=np.int32)[None, :],
             (K, chunk))
         program = self._chunk_program_paged(chunk, K, job["bucket"], final)
+        self.steps.note_dispatch("chunk")
         try:
-            if self.faults is not None:
-                self.faults.hit("engine.chunk")
-            if not final:
-                job["tmp_k"], job["tmp_v"], job["selected"] = program(
-                    self.params, job["tmp_k"], job["tmp_v"],
-                    jnp.asarray(ctokens), jnp.asarray(cpositions),
-                    jnp.asarray(job["lengths"]),
-                    jnp.asarray(start, dtype=jnp.int32), job["selected"])
-                first_tok = None
-            elif self._q8:
-                (self.k_cache, self.v_cache, self.k_scale, self.v_scale,
-                 self._tokens, self._positions, self._temps, self.rng,
-                 first_tok) = program(
-                    self.params, self.k_cache, self.v_cache, self.k_scale,
-                    self.v_scale, job["tmp_k"], job["tmp_v"],
-                    jnp.asarray(ctokens), jnp.asarray(cpositions),
-                    jnp.asarray(job["ptable"]),
-                    jnp.asarray(np.asarray(job["slots_idx"],
-                                           dtype=np.int32)),
-                    jnp.asarray(job["lengths"]),
-                    jnp.asarray(start, dtype=jnp.int32), job["selected"],
-                    self._tokens, self._positions, self._temps,
-                    jnp.asarray(job["new_temps"]), self.rng)
-            else:
-                (self.k_cache, self.v_cache, self._tokens, self._positions,
-                 self._temps, self.rng, first_tok) = program(
-                    self.params, self.k_cache, self.v_cache, job["tmp_k"],
-                    job["tmp_v"], jnp.asarray(ctokens),
-                    jnp.asarray(cpositions), jnp.asarray(job["ptable"]),
-                    jnp.asarray(np.asarray(job["slots_idx"],
-                                           dtype=np.int32)),
-                    jnp.asarray(job["lengths"]),
-                    jnp.asarray(start, dtype=jnp.int32), job["selected"],
-                    self._tokens, self._positions, self._temps,
-                    jnp.asarray(job["new_temps"]), self.rng)
+            with self.steps.seg("dispatch"):
+                if self.faults is not None:
+                    self.faults.hit("engine.chunk")
+                if not final:
+                    job["tmp_k"], job["tmp_v"], job["selected"] = program(
+                        self.params, job["tmp_k"], job["tmp_v"],
+                        jnp.asarray(ctokens), jnp.asarray(cpositions),
+                        jnp.asarray(job["lengths"]),
+                        jnp.asarray(start, dtype=jnp.int32), job["selected"])
+                    first_tok = None
+                elif self._q8:
+                    (self.k_cache, self.v_cache, self.k_scale, self.v_scale,
+                     self._tokens, self._positions, self._temps, self.rng,
+                     first_tok) = program(
+                        self.params, self.k_cache, self.v_cache, self.k_scale,
+                        self.v_scale, job["tmp_k"], job["tmp_v"],
+                        jnp.asarray(ctokens), jnp.asarray(cpositions),
+                        jnp.asarray(job["ptable"]),
+                        jnp.asarray(np.asarray(job["slots_idx"],
+                                               dtype=np.int32)),
+                        jnp.asarray(job["lengths"]),
+                        jnp.asarray(start, dtype=jnp.int32), job["selected"],
+                        self._tokens, self._positions, self._temps,
+                        jnp.asarray(job["new_temps"]), self.rng)
+                else:
+                    (self.k_cache, self.v_cache, self._tokens,
+                     self._positions, self._temps, self.rng,
+                     first_tok) = program(
+                        self.params, self.k_cache, self.v_cache, job["tmp_k"],
+                        job["tmp_v"], jnp.asarray(ctokens),
+                        jnp.asarray(cpositions), jnp.asarray(job["ptable"]),
+                        jnp.asarray(np.asarray(job["slots_idx"],
+                                               dtype=np.int32)),
+                        jnp.asarray(job["lengths"]),
+                        jnp.asarray(start, dtype=jnp.int32), job["selected"],
+                        self._tokens, self._positions, self._temps,
+                        jnp.asarray(job["new_temps"]), self.rng)
         except Exception as exc:
             raise CacheLostError(
                 f"paged chunk prefill dispatch failed: {exc}") from exc
@@ -863,7 +874,8 @@ class PagedLLMEngine(LLMEngine):
 
     def _verify_call(self, drafts, lens):
         jnp = self._jnp
-        table = self._build_table()
+        with self.steps.seg("host_prep"):
+            table = self._build_table()
         program = self._verify_program(table.shape[1])
         (self.k_cache, self.v_cache, self._tokens, self._positions,
          self.rng, out_tokens, n_emit) = program(
@@ -964,65 +976,68 @@ class PagedLLMEngine(LLMEngine):
         from .. import native
 
         K = len(batch)
-        prefix_lens = np.asarray([len(h) * ps for h in hits],
+        with self.steps.seg("host_prep"):
+            prefix_lens = np.asarray([len(h) * ps for h in hits],
+                                     dtype=np.int32)
+            lengths = np.asarray([len(r.resume_tokens) for r in batch],
                                  dtype=np.int32)
-        lengths = np.asarray([len(r.resume_tokens) for r in batch],
-                             dtype=np.int32)
-        tails = [r.resume_tokens[len(h) * ps:]
-                 for r, h in zip(batch, hits)]
-        ptokens = native.pad_batch(tails, bucket)
-        if ptokens is None:
-            ptokens = np.zeros((K, bucket), dtype=np.int32)
-            for row, tail in enumerate(tails):
-                ptokens[row, :len(tail)] = tail
-        if self.sampling_controls:
-            from .sampling import pack_controls
+            tails = [r.resume_tokens[len(h) * ps:]
+                     for r, h in zip(batch, hits)]
+            ptokens = native.pad_batch(tails, bucket)
+            if ptokens is None:
+                ptokens = np.zeros((K, bucket), dtype=np.int32)
+                for row, tail in enumerate(tails):
+                    ptokens[row, :len(tail)] = tail
+            if self.sampling_controls:
+                from .sampling import pack_controls
 
-            new_temps = pack_controls([r.temperature for r in batch],
-                                      [r.top_p for r in batch],
-                                      [r.top_k for r in batch])
-        else:
-            new_temps = np.asarray([r.temperature for r in batch],
-                                   dtype=np.float32)
-        # table: shared prefix pages then the reservation's fresh pages,
-        # wide enough for every row's full PROMPT page span
-        n_table = _pow2_at_least(
-            max(self.allocator.pages_for(int(n)) for n in lengths))
-        ptable = np.zeros((K, n_table), dtype=np.int32)
-        for row, request in enumerate(batch):
-            pages = self._reservations.get(request.id)
-            if pages is None:  # direct submit path outside _admit (tests)
-                pages = self.allocator.alloc(
-                    self._request_pages(request) - len(hits[row]))
-                if pages is None:
-                    raise RuntimeError("page pool exhausted at dispatch")
-                self._reservations[request.id] = pages
-            combined = (hits[row] + pages)[:n_table]
-            ptable[row, :len(combined)] = combined
+                new_temps = pack_controls([r.temperature for r in batch],
+                                          [r.top_p for r in batch],
+                                          [r.top_k for r in batch])
+            else:
+                new_temps = np.asarray([r.temperature for r in batch],
+                                       dtype=np.float32)
+            # table: shared prefix pages then the reservation's fresh pages,
+            # wide enough for every row's full PROMPT page span
+            n_table = _pow2_at_least(
+                max(self.allocator.pages_for(int(n)) for n in lengths))
+            ptable = np.zeros((K, n_table), dtype=np.int32)
+            for row, request in enumerate(batch):
+                pages = self._reservations.get(request.id)
+                if pages is None:  # direct submit path outside _admit (tests)
+                    pages = self.allocator.alloc(
+                        self._request_pages(request) - len(hits[row]))
+                    if pages is None:
+                        raise RuntimeError("page pool exhausted at dispatch")
+                    self._reservations[request.id] = pages
+                combined = (hits[row] + pages)[:n_table]
+                ptable[row, :len(combined)] = combined
 
         program = self._prefix_program(bucket, K, n_table)
+        self.steps.note_dispatch("prefill")
         try:
-            if self.faults is not None:
-                self.faults.hit("engine.prefill")
-            if self._q8:
-                (self.k_cache, self.v_cache, self.k_scale, self.v_scale,
-                 self._tokens, self._positions, self._temps, self.rng,
-                 first) = program(
-                    self.params, self.k_cache, self.v_cache, self.k_scale,
-                    self.v_scale, jnp.asarray(ptokens), jnp.asarray(ptable),
-                    jnp.asarray(prefix_lens),
-                    jnp.asarray(np.asarray(slots_idx, dtype=np.int32)),
-                    jnp.asarray(lengths), self._tokens, self._positions,
-                    self._temps, jnp.asarray(new_temps), self.rng)
-            else:
-                (self.k_cache, self.v_cache, self._tokens, self._positions,
-                 self._temps, self.rng, first) = program(
-                    self.params, self.k_cache, self.v_cache,
-                    jnp.asarray(ptokens), jnp.asarray(ptable),
-                    jnp.asarray(prefix_lens),
-                    jnp.asarray(np.asarray(slots_idx, dtype=np.int32)),
-                    jnp.asarray(lengths), self._tokens, self._positions,
-                    self._temps, jnp.asarray(new_temps), self.rng)
+            with self.steps.seg("dispatch"):
+                if self.faults is not None:
+                    self.faults.hit("engine.prefill")
+                if self._q8:
+                    (self.k_cache, self.v_cache, self.k_scale, self.v_scale,
+                     self._tokens, self._positions, self._temps, self.rng,
+                     first) = program(
+                        self.params, self.k_cache, self.v_cache, self.k_scale,
+                        self.v_scale, jnp.asarray(ptokens),
+                        jnp.asarray(ptable), jnp.asarray(prefix_lens),
+                        jnp.asarray(np.asarray(slots_idx, dtype=np.int32)),
+                        jnp.asarray(lengths), self._tokens, self._positions,
+                        self._temps, jnp.asarray(new_temps), self.rng)
+                else:
+                    (self.k_cache, self.v_cache, self._tokens,
+                     self._positions, self._temps, self.rng, first) = program(
+                        self.params, self.k_cache, self.v_cache,
+                        jnp.asarray(ptokens), jnp.asarray(ptable),
+                        jnp.asarray(prefix_lens),
+                        jnp.asarray(np.asarray(slots_idx, dtype=np.int32)),
+                        jnp.asarray(lengths), self._tokens, self._positions,
+                        self._temps, jnp.asarray(new_temps), self.rng)
         except Exception as exc:
             raise CacheLostError(
                 f"prefix prefill dispatch failed: {exc}") from exc
@@ -1075,40 +1090,44 @@ class PagedLLMEngine(LLMEngine):
                 return
         K = len(batch)
         jnp = self._jnp
-        ptokens, lengths, new_temps = self._prep_admission(bucket, batch)
-        n_ptable = max(1, math.ceil(bucket / self.page_size))
-        ptable = np.zeros((K, n_ptable), dtype=np.int32)
-        for row, request in enumerate(batch):
-            pages = self._reservations.get(request.id)
-            if pages is None:  # direct submit path outside _admit (tests)
-                pages = self.allocator.alloc(self._request_pages(request))
-                if pages is None:
-                    raise RuntimeError("page pool exhausted at dispatch")
-                self._reservations[request.id] = pages
-            prompt_pages = pages[:n_ptable]
-            ptable[row, :len(prompt_pages)] = prompt_pages
+        with self.steps.seg("host_prep"):
+            ptokens, lengths, new_temps = self._prep_admission(bucket, batch)
+            n_ptable = max(1, math.ceil(bucket / self.page_size))
+            ptable = np.zeros((K, n_ptable), dtype=np.int32)
+            for row, request in enumerate(batch):
+                pages = self._reservations.get(request.id)
+                if pages is None:  # direct submit path outside _admit (tests)
+                    pages = self.allocator.alloc(self._request_pages(request))
+                    if pages is None:
+                        raise RuntimeError("page pool exhausted at dispatch")
+                    self._reservations[request.id] = pages
+                prompt_pages = pages[:n_ptable]
+                ptable[row, :len(prompt_pages)] = prompt_pages
 
         program = self._prefill_program(bucket, K)
+        self.steps.note_dispatch("prefill")
         try:
-            if self.faults is not None:
-                self.faults.hit("engine.prefill")
-            if self._q8:
-                (self.k_cache, self.v_cache, self.k_scale, self.v_scale,
-                 self._tokens, self._positions, self._temps, self.rng,
-                 first) = program(
-                    self.params, self.k_cache, self.v_cache, self.k_scale,
-                    self.v_scale, jnp.asarray(ptokens), jnp.asarray(ptable),
-                    jnp.asarray(np.asarray(slots_idx, dtype=np.int32)),
-                    jnp.asarray(lengths), self._tokens, self._positions,
-                    self._temps, jnp.asarray(new_temps), self.rng)
-            else:
-                (self.k_cache, self.v_cache, self._tokens, self._positions,
-                 self._temps, self.rng, first) = program(
-                    self.params, self.k_cache, self.v_cache,
-                    jnp.asarray(ptokens), jnp.asarray(ptable),
-                    jnp.asarray(np.asarray(slots_idx, dtype=np.int32)),
-                    jnp.asarray(lengths), self._tokens, self._positions,
-                    self._temps, jnp.asarray(new_temps), self.rng)
+            with self.steps.seg("dispatch"):
+                if self.faults is not None:
+                    self.faults.hit("engine.prefill")
+                if self._q8:
+                    (self.k_cache, self.v_cache, self.k_scale, self.v_scale,
+                     self._tokens, self._positions, self._temps, self.rng,
+                     first) = program(
+                        self.params, self.k_cache, self.v_cache, self.k_scale,
+                        self.v_scale, jnp.asarray(ptokens),
+                        jnp.asarray(ptable),
+                        jnp.asarray(np.asarray(slots_idx, dtype=np.int32)),
+                        jnp.asarray(lengths), self._tokens, self._positions,
+                        self._temps, jnp.asarray(new_temps), self.rng)
+                else:
+                    (self.k_cache, self.v_cache, self._tokens,
+                     self._positions, self._temps, self.rng, first) = program(
+                        self.params, self.k_cache, self.v_cache,
+                        jnp.asarray(ptokens), jnp.asarray(ptable),
+                        jnp.asarray(np.asarray(slots_idx, dtype=np.int32)),
+                        jnp.asarray(lengths), self._tokens, self._positions,
+                        self._temps, jnp.asarray(new_temps), self.rng)
         except Exception as exc:
             raise CacheLostError(f"paged prefill dispatch failed: {exc}") from exc
 
@@ -1127,29 +1146,32 @@ class PagedLLMEngine(LLMEngine):
         # position clamps its page_slot to the LAST column, which must be
         # garbage (0) for every row so dead steps can never write into a
         # live page
-        table = self._build_table()
+        with self.steps.seg("host_prep"):
+            table = self._build_table()
         n_table = table.shape[1]
         block = self._decode_block_now()
         program = self._decode_program_paged(n_table, block)
         snapshot = [(i, slot.request) for i, slot in enumerate(self.slots)
                     if slot.active]
-        start = _time.time()
+        self.steps.note_dispatch("decode")
+        start = _time.monotonic()
         try:
-            if self.faults is not None:
-                self.faults.hit("engine.decode")
-            if self._q8:
-                (self.k_cache, self.v_cache, self.k_scale, self.v_scale,
-                 self._tokens, self._positions, self.rng, out_tokens) = \
-                    program(self.params, self.k_cache, self.v_cache,
-                            self.k_scale, self.v_scale, jnp.asarray(table),
-                            self._tokens, self._positions, self._temps,
-                            self.rng)
-            else:
-                (self.k_cache, self.v_cache, self._tokens, self._positions,
-                 self.rng, out_tokens) = program(
-                    self.params, self.k_cache, self.v_cache,
-                    jnp.asarray(table), self._tokens, self._positions,
-                    self._temps, self.rng)
+            with self.steps.seg("dispatch"):
+                if self.faults is not None:
+                    self.faults.hit("engine.decode")
+                if self._q8:
+                    (self.k_cache, self.v_cache, self.k_scale, self.v_scale,
+                     self._tokens, self._positions, self.rng, out_tokens) = \
+                        program(self.params, self.k_cache, self.v_cache,
+                                self.k_scale, self.v_scale,
+                                jnp.asarray(table), self._tokens,
+                                self._positions, self._temps, self.rng)
+                else:
+                    (self.k_cache, self.v_cache, self._tokens,
+                     self._positions, self.rng, out_tokens) = program(
+                        self.params, self.k_cache, self.v_cache,
+                        jnp.asarray(table), self._tokens, self._positions,
+                        self._temps, self.rng)
         except Exception as exc:
             raise CacheLostError(f"paged decode dispatch failed: {exc}") from exc
         dspan = self._dispatch_span("tpu.decode", next(self._batch_seq),

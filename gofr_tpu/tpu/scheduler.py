@@ -28,7 +28,9 @@ class _WorkItem:
     def __init__(self, payload):
         self.payload = payload
         self.future: Future = Future()
-        self.enqueued_at = time.time()
+        # monotonic like the engine's request stamps: TTFT math must not
+        # bend under an NTP step
+        self.enqueued_at = time.monotonic()
 
 
 class DynamicBatcher:
@@ -139,9 +141,9 @@ class DynamicBatcher:
         except queue.Empty:
             return []
         items = [first]
-        deadline = time.time() + self.window_s
+        deadline = time.monotonic() + self.window_s
         while len(items) < self.max_batch:
-            remaining = deadline - time.time()
+            remaining = deadline - time.monotonic()
             if remaining <= 0:
                 break
             try:
@@ -194,7 +196,7 @@ class DynamicBatcher:
         self._obs.hist("app_tpu_batch_size", n)
         self._obs.gauge("app_tpu_queue_depth", self._queue.qsize())
         outputs = np.asarray(outputs)
-        now = time.time()
+        now = time.monotonic()
         for i, item in enumerate(items):
             if not item.future.done():
                 item.future.set_result(outputs[i])

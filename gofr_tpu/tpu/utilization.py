@@ -163,7 +163,11 @@ class UtilizationLedger:
         # (t, host_s) — scheduler/prep/demux time noted by the engine loop
         self._host: "collections.deque" = collections.deque()
         self._busy_until = 0.0          # device-busy union watermark
-        self._created_at = created_at if created_at is not None else time.time()
+        # MONOTONIC clock domain: the engine stamps dispatch/sync times
+        # with time.monotonic() (an NTP step must not warp the busy
+        # window), so the window's own "now" must come from the same clock
+        self._created_at = (created_at if created_at is not None
+                            else time.monotonic())
         self._obs = MetricsHook(metrics)
         self.dispatches_total = 0
 
@@ -226,7 +230,7 @@ class UtilizationLedger:
         if seconds <= 0.0:
             return
         with self._lock:
-            t = now if now is not None else time.time()
+            t = now if now is not None else time.monotonic()
             self._host.append((t, seconds))
             self._prune(t)
 
@@ -239,7 +243,7 @@ class UtilizationLedger:
 
     # -- rolling window read-out ----------------------------------------------
     def window_stats(self, now: Optional[float] = None) -> Dict[str, Any]:
-        now = now if now is not None else time.time()
+        now = now if now is not None else time.monotonic()
         peak_flops, peak_bw, peak_source = self.peaks()
         agg_flops = {"prefill": 0.0, "decode": 0.0}
         agg_bytes = {"prefill": 0.0, "decode": 0.0}

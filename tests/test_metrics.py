@@ -1,6 +1,7 @@
 import pytest
 
-from gofr_tpu.metrics import DuplicateMetric, Manager, MetricNotFound
+from gofr_tpu.metrics import (DuplicateMetric, Manager, MetricNotFound,
+                              format_bucket_bound)
 
 
 def test_counter_roundtrip():
@@ -77,6 +78,82 @@ def test_histogram_percentile_midpoints():
     assert hist.percentile(1.0) == 8
 
 
+def test_le_label_canonical_formatting():
+    """The pinned `le` rendering contract: never exponent notation, one
+    trailing decimal for integral bounds, ints and their float twins emit
+    IDENTICAL series (repr() used to give le="1" vs le="1.0")."""
+    assert format_bucket_bound(1e-05) == "0.00001"
+    assert format_bucket_bound(0.005) == "0.005"
+    assert format_bucket_bound(2.5) == "2.5"
+    assert format_bucket_bound(1) == "1.0"
+    assert format_bucket_bound(1.0) == "1.0"
+    assert format_bucket_bound(30) == "30.0"
+    assert format_bucket_bound(float("inf")) == "+Inf"
+    m = Manager()
+    m.new_histogram("tiny", "", buckets=(1e-05, 1, 2.5))
+    m.record_histogram("tiny", 0.5)
+    text = m.expose()
+    assert 'tiny_bucket{le="0.00001"} 0' in text
+    assert 'tiny_bucket{le="1.0"} 1' in text
+    assert 'tiny_bucket{le="2.5"} 1' in text
+    assert 'le="1e-05"' not in text
+
+
+def test_exemplars_openmetrics_only_and_last_write_wins():
+    """Exemplars surface ONLY under the OpenMetrics dialect; per bucket
+    the most recent exemplar wins; classic exposition is byte-identical
+    with or without them."""
+    m = Manager()
+    m.new_histogram("lat", "", buckets=(0.1, 1.0))
+    m.record_histogram("lat", 0.05)             # no exemplar
+    classic_before = m.expose()
+    m.record_histogram("lat", 0.04,
+                       exemplar={"request_id": 7, "trace_id": "abc"})
+    m.record_histogram("lat", 0.06, exemplar={"request_id": 9})
+    m.record_histogram("lat", 5.0, exemplar={"request_id": 11})  # +Inf
+
+    om = m.expose(openmetrics=True)
+    assert om.rstrip().endswith("# EOF")
+    # bucket 0.1: last write (request 9) won; +Inf overflow carries 11
+    assert 'lat_bucket{le="0.1"} 3 # {request_id="9"} 0.06' in om
+    assert 'lat_bucket{le="+Inf"} 4 # {request_id="11"} 5.0' in om
+
+    classic = m.expose()
+    assert "# {" not in classic and "# EOF" not in classic
+    # classic output is the openmetrics output minus exemplars and EOF
+    stripped = "\n".join(line.split(" # {")[0] for line in om.splitlines()
+                         if line != "# EOF")
+    assert stripped.strip() == classic.strip()
+    # and recording exemplars never changed the classic line SHAPE
+    assert classic.count("lat_bucket") == classic_before.count("lat_bucket")
+
+
+def test_metrics_hook_drop_counter_and_once_per_name_log():
+    """The MetricsHook satellite: swallowed recordings increment
+    app_obs_dropped_metrics_total{name} and log once per name, so a
+    typo'd metric is findable instead of silently invisible."""
+    from gofr_tpu.logging import MockLogger
+    from gofr_tpu.tpu.obs import MetricsHook
+
+    m = Manager()
+    m.new_counter("real_total", "")
+    logger = MockLogger()
+    hook = MetricsHook(m, logger=logger)
+    hook.counter("real_total")              # fine: no drop
+    for _ in range(3):
+        hook.counter("nope_total")          # unregistered: dropped
+        hook.hist("nope_hist", 0.5)
+    text = m.expose()
+    assert 'app_obs_dropped_metrics_total{name="nope_total"} 3.0' in text
+    assert 'app_obs_dropped_metrics_total{name="nope_hist"} 3.0' in text
+    assert 'name="real_total"' not in text
+    # once-per-name: two names -> exactly two dropped-log lines
+    lines = [ln for ln in logger.output().splitlines() if "dropped" in ln]
+    assert len(lines) == 2
+    assert sum("nope_total" in ln for ln in lines) == 1
+    assert sum("nope_hist" in ln for ln in lines) == 1
+
+
 def test_exposition_is_safe_under_concurrent_label_churn():
     """Scrape-while-recording stress: hot-loop add()/record_n() inserting
     NEW label keys while /metrics renders must never raise
@@ -101,7 +178,11 @@ def test_exposition_is_safe_under_concurrent_label_churn():
             try:
                 m.increment_counter("churn_total", 1, worker=key)
                 m.set_gauge("churn_gauge", i, worker=key)
-                m.record_histogram_n("churn_hist", 0.5, 3, worker=key)
+                # exemplars ride the same hot path: every record attaches
+                # one, so the openmetrics scrape below renders exemplar
+                # state that is mutating concurrently
+                m.record_histogram_n("churn_hist", 0.5, 3, worker=key,
+                                     exemplar={"request_id": i})
             except Exception as exc:  # noqa: BLE001 - the bug under test
                 record_errors.append(exc)
                 return
@@ -114,8 +195,13 @@ def test_exposition_is_safe_under_concurrent_label_churn():
     try:
         deadline = time.time() + 2.0   # time-bounded: cardinality grows
         while time.time() < deadline:  # fast, so a count loop would drag
+            # alternate dialects: classic must never leak an exemplar,
+            # openmetrics must render them mid-churn without raising
             text = m.expose()   # raises RuntimeError without the snapshot
             assert "churn_total" in text
+            assert "# {" not in text
+            om = m.expose(openmetrics=True)
+            assert om.rstrip().endswith("# EOF")
             scrapes += 1
     finally:
         stop.set()
@@ -123,3 +209,6 @@ def test_exposition_is_safe_under_concurrent_label_churn():
             t.join(timeout=10)
     assert scrapes > 0
     assert not record_errors
+    # the exemplars survived the churn: the final openmetrics scrape
+    # carries at least one on the histogram
+    assert '# {request_id="' in m.expose(openmetrics=True)

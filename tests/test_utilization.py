@@ -302,9 +302,14 @@ def test_metric_inventory_consistency():
                 if name.startswith("app_tpu_"):
                     recorded.add(name)
     assert recorded, "inventory scan found no recorded metrics (regex rot?)"
+    # the step-anatomy names must be IN the scan (guards regex rot against
+    # the stepledger module's recording style)
+    assert "app_tpu_step_seconds" in recorded
+    assert "app_tpu_step_stragglers_total" in recorded
 
     from gofr_tpu.tpu.device import TPUClient
     from gofr_tpu.tpu.flightrecorder import register_slo_gauges
+    from gofr_tpu.tpu.stepledger import register_step_metrics
 
     manager = Manager()
     client = TPUClient()
@@ -312,6 +317,7 @@ def test_metric_inventory_consistency():
     client.register_metrics()
     register_slo_gauges(manager)
     register_utilization_metrics(manager)
+    register_step_metrics(manager)  # idempotent next to register_metrics
     registered = set(manager._store)
     missing = recorded - registered
     assert not missing, (

@@ -10,8 +10,9 @@ re-reproduced.
 
 Each line also carries the fleet-level `/debug/engine` snapshot (slots,
 page pool, utilization window — MFU/MBU/duty-cycle — and compile-cache
-totals), so soak artifacts gain an efficiency axis next to the tail
-evidence.
+totals) and the `/debug/steps` anatomy summary (per-phase step-time
+baselines, segment totals, recent stragglers), so soak artifacts gain an
+efficiency axis and a step-anatomy axis next to the tail evidence.
 
 Usage:
     python tools/obs_dump.py [--server http://127.0.0.1:8000]
@@ -87,6 +88,21 @@ def poll_once(server: str, metrics_base: str) -> dict:
         entry["engine"] = engine
     except Exception as exc:  # noqa: BLE001 - older servers lack the route
         entry["engine_error"] = str(exc)
+    try:
+        body = json.loads(_get(server.rstrip("/") + "/debug/steps?recent=8"))
+        snap = body.get("data", body)
+        # summary-level only: baselines + per-phase segment totals +
+        # stragglers carry the step-anatomy signal; the full ring would
+        # bloat the JSONL stream
+        entry["steps"] = {
+            "steps_total": snap.get("steps_total"),
+            "stragglers_total": snap.get("stragglers_total"),
+            "baselines": snap.get("baselines"),
+            "summary": snap.get("summary"),
+            "stragglers": snap.get("stragglers", [])[-5:],
+        }
+    except Exception as exc:  # noqa: BLE001 - older servers lack the route
+        entry["steps_error"] = str(exc)
     try:
         entry["gauges"] = scrape_gauges(metrics_base)
     except Exception as exc:  # noqa: BLE001

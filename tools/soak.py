@@ -222,6 +222,23 @@ def run_profile(profile: str, seconds: float, n_threads: int,
             "dispatches": u["dispatches"],
             "peak_source": u["peak_source"],
         }
+    # step-anatomy axis (tpu/stepledger.py): the final per-phase segment
+    # breakdown + straggler count, so a soak with a throughput dip also
+    # says WHERE the step time went (dispatch? sync? page_alloc?)
+    steps = getattr(engine, "steps", None)
+    if steps is not None:
+        step_snap = steps.snapshot(recent=1)
+        stats["step_anatomy"] = {
+            "steps_total": step_snap["steps_total"],
+            "stragglers_total": step_snap["stragglers_total"],
+            "baselines": step_snap["baselines"],
+            "by_phase": {
+                phase: {"steps": agg["steps"],
+                        "mean_wall_s": agg["mean_wall_s"],
+                        "segments": agg["segments"]}
+                for phase, agg in step_snap["summary"].items()},
+            "stragglers": step_snap["stragglers"][-5:],
+        }
     # the 5 slowest-TTFT completions, full phase breakdown each
     with_ttft = [r for r in snap["recent"] if "ttft_s" in r]
     stats["slowest_ttft"] = sorted(with_ttft, key=lambda r: -r["ttft_s"])[:5]
