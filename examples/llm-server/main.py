@@ -342,6 +342,14 @@ def build_app(config=None, engine=None) -> App:
     # STEP_BASELINE_* tune the ring and sentinel
     if app.config.get_bool("STEP_LEDGER", True):
         app.enable_step_ledger(engine)
+    # incident autopsy plane: SLO burn-rate engine (GET /debug/slo,
+    # app_tpu_slo_burn_rate / app_tpu_slo_alert_state) + anomaly-triggered
+    # evidence bundles (GET /debug/incidents); fed by the flight recorder,
+    # triggered by burn pages, straggler streaks, breaker opens, and
+    # quarantines. INCIDENT_AUTOPSY=false opts out; SLO_BURN_* /
+    # INCIDENT_* tune windows, thresholds, and the capture rate limit
+    if app.config.get_bool("INCIDENT_AUTOPSY", True):
+        app.enable_incident_autopsy(engine)
     # chaos plane: POST /debug/faults + engine/executor/device fault hooks.
     # HARD-gated on FAULT_INJECTION=true — disabled (the default) keeps the
     # zero-overhead faults=None fast path and the endpoint 404s

@@ -85,6 +85,10 @@ def build_app(**kw) -> App:
     # parity; STEP_LEDGER=false opts out)
     if app.config.get_bool("STEP_LEDGER", True):
         app.enable_step_ledger(engine)
+    # incident autopsy plane: GET /debug/slo + /debug/incidents (llm-server
+    # parity; INCIDENT_AUTOPSY=false opts out, SLO_BURN_*/INCIDENT_* tune)
+    if app.config.get_bool("INCIDENT_AUTOPSY", True):
+        app.enable_incident_autopsy(engine)
     # chaos plane (llm-server parity): 404s unless FAULT_INJECTION=true
     app.enable_fault_injection(engine)
     tokenizer = engine.tokenizer

@@ -377,6 +377,77 @@ class App:
         install_routes(self, ledger, path)
         return ledger
 
+    def enable_incident_autopsy(self, engine, slo_path: str = "/debug/slo",
+                                incidents_path: str = "/debug/incidents"):
+        """Wire the incident autopsy plane (tpu/incidents.py) onto an
+        engine: the SLO burn-rate engine (error-budget accounting over
+        paired fast/slow windows, fed by the flight recorder, published
+        as app_tpu_slo_burn_rate{slo,window} / app_tpu_slo_alert_state
+        {slo} and served at GET /debug/slo) plus the IncidentManager
+        (anomaly-triggered, rate-limited evidence bundles at
+        GET /debug/incidents[/{id}], triggered by burn-rate pages,
+        straggler streaks, breaker opens, and poison quarantines).
+
+        Config: SLO_BURN_FAST_WINDOW_S / SLO_BURN_SLOW_WINDOW_S (paired
+        windows, defaults 300/3600), SLO_BURN_PAGE / SLO_BURN_WARN
+        (both-windows burn thresholds, 14.4/6.0),
+        SLO_BURN_OBJECTIVE_{TTFT,TPOT,AVAILABILITY} (objectives,
+        0.99/0.99/0.999), SLO_BURN_MIN_EVENTS (window arm floor, 12);
+        INCIDENT_DIR (bundle directory, ./incidents), INCIDENT_RING
+        (in-memory bundle ring, 32), INCIDENT_COOLDOWN_S /
+        INCIDENT_MAX_PER_HOUR (capture rate limit, 300/6),
+        INCIDENT_SLOWEST_K (requests embedded per bundle, 5),
+        INCIDENT_PROFILE_S (attach an async xprof capture per bundle;
+        0 = off; a busy profiler is skipped, never awaited),
+        INCIDENT_STRAGGLER_STREAK / INCIDENT_STRAGGLER_WINDOW (flagged
+        steps within a step span that escalate, 3/32). Returns
+        (burn_engine, incident_manager)."""
+        from .tpu.incidents import (IncidentManager, SLOBurnEngine,
+                                    install_routes,
+                                    register_incident_metrics)
+
+        cfg = self.config
+        metrics = self.container.metrics_manager
+        if metrics is not None:
+            register_incident_metrics(metrics)
+        recorder = getattr(engine, "recorder", None)
+        burn = SLOBurnEngine(
+            slo_ttft_s=cfg.get_float("SLO_TTFT_TARGET_S", 0.150),
+            slo_tpot_s=cfg.get_float("SLO_TPOT_TARGET_S", 0.050),
+            objectives={
+                "ttft": cfg.get_float("SLO_BURN_OBJECTIVE_TTFT", 0.99),
+                "tpot": cfg.get_float("SLO_BURN_OBJECTIVE_TPOT", 0.99),
+                "availability": cfg.get_float(
+                    "SLO_BURN_OBJECTIVE_AVAILABILITY", 0.999)},
+            fast_window_s=cfg.get_float("SLO_BURN_FAST_WINDOW_S", 300.0),
+            slow_window_s=cfg.get_float("SLO_BURN_SLOW_WINDOW_S", 3600.0),
+            page_burn=cfg.get_float("SLO_BURN_PAGE", 14.4),
+            warn_burn=cfg.get_float("SLO_BURN_WARN", 6.0),
+            min_events=cfg.get_int("SLO_BURN_MIN_EVENTS", 12),
+            metrics=metrics, logger=self.logger)
+        incidents = IncidentManager(
+            engine=engine, recorder=recorder,
+            dir=cfg.get_or_default("INCIDENT_DIR", "./incidents"),
+            capacity=cfg.get_int("INCIDENT_RING", 32),
+            cooldown_s=cfg.get_float("INCIDENT_COOLDOWN_S", 300.0),
+            max_per_hour=cfg.get_int("INCIDENT_MAX_PER_HOUR", 6),
+            slowest_k=cfg.get_int("INCIDENT_SLOWEST_K", 5),
+            profile_seconds=cfg.get_float("INCIDENT_PROFILE_S", 0.0),
+            straggler_streak=cfg.get_int("INCIDENT_STRAGGLER_STREAK", 3),
+            straggler_window=cfg.get_int("INCIDENT_STRAGGLER_WINDOW", 32),
+            fingerprint={"app": self.container.app_name,
+                         "version": self.container.app_version},
+            metrics=metrics, logger=self.logger)
+        burn.on_page = incidents.on_slo_page
+        if recorder is not None:
+            recorder.use_burn_engine(burn)
+        engine.incidents = incidents
+        # scrape-time re-evaluation: burn must DECAY while the server is
+        # idle (no completions would otherwise freeze a paging state)
+        self.container.add_scrape_hook("slo_burn", burn.publish)
+        install_routes(self, burn, incidents, slo_path, incidents_path)
+        return burn, incidents
+
     # -- cross-cutting registrations ------------------------------------------
     def add_http_service(self, name: str, address: str, *options) -> None:
         from .service import new_http_service

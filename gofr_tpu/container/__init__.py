@@ -68,8 +68,12 @@ class Container:
             "app_info", 1, app_name=c.app_name, app_version=c.app_version,
             framework_version=version.FRAMEWORK)
 
-        c.tracer = Tracer(service_name=c.app_name,
-                          exporter=exporter_from_config(config, c.logger))
+        exporter = exporter_from_config(config, c.logger)
+        if hasattr(exporter, "use_metrics"):
+            # the async exporters count queue-overflow drops in
+            # app_obs_dropped_spans_total (registered below)
+            exporter.use_metrics(c.metrics_manager)
+        c.tracer = Tracer(service_name=c.app_name, exporter=exporter)
 
         remote_url = config.get_or_default("REMOTE_LOG_URL", "")
         if remote_url:
@@ -148,6 +152,10 @@ class Container:
         m.new_counter("app_pubsub_subscribe_total_count", "messages received")
         m.new_counter("app_pubsub_commit_total_count", "messages committed")
         m.new_counter("app_pubsub_subscribe_failure_count", "handler failures")
+        m.new_counter("app_obs_dropped_spans_total",
+                      "finished spans dropped by the async trace exporter's "
+                      "bounded queue (a dead/slow collector sheds spans "
+                      "instead of blocking the span-ending thread)")
 
     def add_scrape_hook(self, name: str, fn) -> None:
         """fn() runs at every metrics scrape — for gauges whose owner
@@ -266,6 +274,15 @@ class Container:
         return out
 
     def close(self) -> None:
+        # drain the async trace exporter FIRST: spans ended during the
+        # datasource teardown below are lost either way, but everything
+        # already queued must reach the collector
+        tracer = self.tracer
+        if tracer is not None and hasattr(tracer.exporter, "close"):
+            try:
+                tracer.exporter.close()
+            except Exception:  # noqa: BLE001
+                pass
         for source in (self.sql, self.kv, self.pubsub, self.tpu, self.docstore):
             if source is not None and hasattr(source, "close"):
                 try:

@@ -138,6 +138,12 @@ class TPUClient:
             ("app_tpu_step_stragglers_total",
              "engine steps flagged slower than the rolling per-phase "
              "baseline, by dominant-segment cause"),
+            # incident autopsy plane (tpu/incidents.py)
+            ("app_tpu_incidents_total",
+             "incident evidence bundles captured, by trigger"),
+            ("app_tpu_incidents_suppressed_total",
+             "incident triggers suppressed by the capture rate limit "
+             "(cooldown / max-per-hour), by trigger"),
             # best-effort hook self-observability (tpu/obs.py)
             ("app_obs_dropped_metrics_total",
              "metric recordings swallowed by best-effort hooks, by metric "
@@ -164,6 +170,13 @@ class TPUClient:
             ("app_tpu_slo_tpot_goodput",
              "fraction of recent requests meeting the TPOT target "
              "(flight recorder rolling window)"),
+            # SLO burn-rate engine (tpu/incidents.py)
+            ("app_tpu_slo_burn_rate",
+             "SLO error-budget burn rate (error rate / budget) by slo "
+             "and window (fast/slow)"),
+            ("app_tpu_slo_alert_state",
+             "SLO alert state by slo: 0 ok, 1 warn, 2 page "
+             "(both-windows burn rule)"),
             # utilization ledger (tpu/utilization.py): roofline telemetry
             ("app_tpu_device_duty_cycle",
              "fraction of the rolling window the device spent executing "

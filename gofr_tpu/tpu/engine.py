@@ -585,6 +585,12 @@ class LLMEngine:
         # fault-injection plane (tpu/faults.py): None in production — every
         # hook site is one attribute check, the zero-overhead contract
         self.faults = faults
+        # incident autopsy plane (tpu/incidents.py): None unless
+        # App.enable_incident_autopsy wires one — the hooks below (breaker
+        # open, quarantine, straggler streak) are one attribute check each
+        # and IncidentManager.trigger never blocks the loop (captures run
+        # on a daemon thread)
+        self.incidents = None
         # crash-only recovery: replay-after-reset budget + reset-storm
         # breaker (tpu/faults.py). Active requests survive a device reset
         # by re-admitting at prompt+emitted with elevated priority; the
@@ -1780,12 +1786,20 @@ class LLMEngine:
             active_slots=sum(1 for s in self.slots if s.active),
             inflight=len(self._inflight),
             queue_depth=self._pending.qsize())
-        if rec is not None and rec.straggler and self.recorder is not None:
-            self.recorder.record_engine_event(
-                "step_straggler", step=rec.seq, phase=rec.phase,
-                wall_s=round(rec.wall_s, 6), cause=rec.cause,
-                baseline_s=round(rec.baseline_s or 0.0, 6),
-                request_id=rec.slowest_request_id)
+        if rec is not None and rec.straggler:
+            if self.recorder is not None:
+                self.recorder.record_engine_event(
+                    "step_straggler", step=rec.seq, phase=rec.phase,
+                    wall_s=round(rec.wall_s, 6), cause=rec.cause,
+                    baseline_s=round(rec.baseline_s or 0.0, 6),
+                    request_id=rec.slowest_request_id)
+            if self.incidents is not None:
+                # a streak of flagged steps (not one) escalates to an
+                # incident; the manager does the streak accounting
+                self.incidents.note_straggler(
+                    step=rec.seq, phase=rec.phase, cause=rec.cause,
+                    wall_s=round(rec.wall_s, 6),
+                    request_id=rec.slowest_request_id)
 
     def _breaker_probe(self) -> None:
         """The reset-storm breaker's half-open probe: ONE tiny device
@@ -2500,6 +2514,12 @@ class LLMEngine:
             if self.recorder is not None:
                 self.recorder.record_engine_event(
                     "breaker_open", **self.breaker.snapshot())
+            if self.incidents is not None:
+                # the autopsy closes here: the storm's evidence (step
+                # ring, engine snapshot, slowest requests) is captured
+                # off-thread while it is still in the bounded rings
+                self.incidents.trigger("breaker_open", error=str(exc),
+                                       breaker=self.breaker.snapshot())
             if self.logger is not None:
                 self.logger.errorf(
                     "reset storm: %d resets inside %.0fs — breaker OPEN, "
@@ -2568,6 +2588,10 @@ class LLMEngine:
                 if self.recorder is not None:
                     self.recorder.record_event(
                         request.id, "quarantined",
+                        consecutive_sole_resets=self._sole_reset_streak)
+                if self.incidents is not None:
+                    self.incidents.trigger(
+                        "quarantine", request_id=request.id,
                         consecutive_sole_resets=self._sole_reset_streak)
                 if self.logger is not None:
                     self.logger.errorf(

@@ -202,6 +202,11 @@ class FlightRecorder:
             maxlen=64)
         self._obs = MetricsHook(metrics)
         self.tracer = tracer
+        # SLO burn-rate engine (tpu/incidents.py): when wired, every
+        # completion and every shed feeds its error-budget windows — the
+        # recorder already owns the TTFT/TPOT measurements and sees the
+        # shed engine events, so it is the one natural tap point
+        self.burn = None
         # terminal events ever recorded — ring eviction never decrements
         # it, so tests (and operators) can assert none were lost
         self.finished_total = 0
@@ -214,6 +219,10 @@ class FlightRecorder:
     def use_tracer(self, tracer) -> None:
         if tracer is not None:
             self.tracer = tracer
+
+    def use_burn_engine(self, burn) -> None:
+        if burn is not None:
+            self.burn = burn
 
     # -- recording (engine-facing, best-effort) -------------------------------
     def record_enqueued(self, request) -> None:
@@ -324,6 +333,13 @@ class FlightRecorder:
             if stats["tpot_goodput"] is not None:
                 self._obs.gauge("app_tpu_slo_tpot_goodput",
                                 stats["tpot_goodput"])
+            if self.burn is not None:
+                # outcome "error"/"aborted" spends availability budget; a
+                # cancel is the client's choice, not a served failure
+                self.burn.observe_request(
+                    rec.ttft_s(), rec.tpot_s(),
+                    error=(rec.error is not None
+                           or reason in ("error", "aborted")))
             self._emit_spans(rec)
         except Exception:  # noqa: BLE001
             pass
@@ -333,6 +349,11 @@ class FlightRecorder:
             with self._lock:
                 self._engine_events.append(
                     {"t": time.time(), "event": name, **data})
+            if self.burn is not None and name in ("stall_shed",
+                                                  "breaker_shed"):
+                # a shed request never reaches record_finished: count the
+                # refusal against the availability budget here
+                self.burn.observe_shed()
         except Exception:  # noqa: BLE001
             pass
 

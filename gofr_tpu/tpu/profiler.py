@@ -34,7 +34,13 @@ _MAX_SECONDS = 60.0
 
 _lock = threading.Lock()
 _state = {"active": False, "pending_dir": None, "started_at": None,
-          "last_dir": None, "last_captured_at": None, "last_error": None}
+          "last_dir": None, "last_captured_at": None, "last_error": None,
+          # capture provenance: who asked ("manual" POST vs. "incident"
+          # autopsy trigger) and the requested window; the MONOTONIC
+          # start stamp backs running_for_s / last_duration_s so an NTP
+          # step can't fake a wedged or instant capture
+          "trigger": None, "seconds": None, "started_mono": None,
+          "last_trigger": None, "last_duration_s": None}
 
 
 def _run_capture(seconds: float, out: str) -> None:
@@ -51,19 +57,29 @@ def _run_capture(seconds: float, out: str) -> None:
     except Exception as exc:  # noqa: BLE001 - surfaced via status, not a crash
         error = str(exc)
     with _lock:
+        started_mono = _state["started_mono"]
         _state["active"] = False
         _state["pending_dir"] = None
+        _state["started_mono"] = None
         _state["last_error"] = error
+        _state["last_trigger"] = _state["trigger"]
+        if started_mono is not None:
+            _state["last_duration_s"] = round(
+                time.monotonic() - started_mono, 3)
         if error is None:
             _state["last_dir"] = out
             _state["last_captured_at"] = time.time()
 
 
-def start_capture(seconds: float, log_dir: str = "./profiles") -> Tuple[str, float]:
+def start_capture(seconds: float, log_dir: str = "./profiles",
+                  trigger: str = "manual") -> Tuple[str, float]:
     """Begin an async capture; returns (trace_dir, bounded_seconds).
 
-    Raises ValueError on a bad duration and RuntimeError while another
-    capture runs (the profiler is a global singleton in the process)."""
+    `trigger` records provenance in status(): "manual" for the POST
+    /debug/profile operator path, "incident" for autopsy-plane captures
+    (tpu/incidents.py). Raises ValueError on a bad duration and
+    RuntimeError while another capture runs (the profiler is a global
+    singleton in the process) — the HTTP route maps that to 409."""
     seconds = min(float(seconds), _MAX_SECONDS)
     if seconds <= 0:
         raise ValueError("profile duration must be positive")
@@ -74,6 +90,9 @@ def start_capture(seconds: float, log_dir: str = "./profiles") -> Tuple[str, flo
         _state["active"] = True
         _state["pending_dir"] = out
         _state["started_at"] = time.time()
+        _state["started_mono"] = time.monotonic()
+        _state["trigger"] = str(trigger)
+        _state["seconds"] = seconds
         _state["last_error"] = None
     try:
         os.makedirs(out, exist_ok=True)
@@ -81,6 +100,7 @@ def start_capture(seconds: float, log_dir: str = "./profiles") -> Tuple[str, flo
         with _lock:
             _state["active"] = False
             _state["pending_dir"] = None
+            _state["started_mono"] = None
         raise
     threading.Thread(target=_run_capture, args=(seconds, out),
                      name="xprof-capture", daemon=True).start()
@@ -105,7 +125,12 @@ def capture_trace(seconds: float, log_dir: str = "./profiles",
 
 def status() -> dict:
     with _lock:
-        return dict(_state)
+        out = dict(_state)
+        if out["started_mono"] is not None:
+            out["running_for_s"] = round(
+                time.monotonic() - out["started_mono"], 3)
+        del out["started_mono"]  # internal clock; epochs stay for display
+    return out
 
 
 def install_routes(app, path: str = "/debug/profile") -> None:
@@ -118,7 +143,8 @@ def install_routes(app, path: str = "/debug/profile") -> None:
         seconds = float(body.get("seconds", 2.0))
         log_dir = str(body.get("dir", "./profiles"))
         try:
-            trace_dir, bounded = start_capture(seconds, log_dir)
+            trace_dir, bounded = start_capture(seconds, log_dir,
+                                               trigger="manual")
         except RuntimeError as exc:
             return Response(status=409,
                             headers={"Content-Type": "application/json"},

@@ -111,16 +111,39 @@ class LogExporter(Exporter):
 class HTTPExporter(Exporter):
     """POSTs finished span batches as JSON, like the reference's custom 'gofr'
     exporter (exporter.go:48-124). Failures are logged and dropped — tracing
-    must never take the service down."""
+    must never take the service down.
 
-    def __init__(self, url: str, logger=None, batch_size: int = 64, flush_interval_s: float = 5.0):
+    Transport runs OFF the span-ending thread: spans end on the engine
+    loop / request path, and a synchronous POST there turns a slow
+    collector into serving latency. ``export`` only appends to a bounded
+    queue (overflow drops the span and counts it in
+    ``app_obs_dropped_spans_total`` — backpressure from a dead collector
+    must shed spans, not block serving); one daemon thread drains the
+    queue on batch-size or flush-interval boundaries (monotonic clock, so
+    an NTP step can neither stall nor storm the flusher). ``close()``
+    flushes what remains."""
+
+    def __init__(self, url: str, logger=None, batch_size: int = 64,
+                 flush_interval_s: float = 5.0, max_queue: int = 2048):
         self.url = url
         self.logger = logger
         self.batch_size = batch_size
         self.flush_interval_s = flush_interval_s
-        self._buf: List[Dict[str, Any]] = []
+        self.max_queue = max(1, int(max_queue))
+        self.metrics = None
+        self.dropped_total = 0
+        self._buf: List[Any] = []
         self._lock = threading.Lock()
-        self._last_flush = time.time()
+        self._last_flush = time.monotonic()
+        self._wake = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._closed = False
+        self._force = False
+        self._sending = False
+
+    def use_metrics(self, metrics) -> None:
+        """Wire the manager that carries app_obs_dropped_spans_total."""
+        self.metrics = metrics
 
     def _span_payload(self, span: Span) -> Dict[str, Any]:
         """Wire shape of one span; subclasses override for zipkin/OTLP."""
@@ -131,18 +154,88 @@ class HTTPExporter(Exporter):
         return batch
 
     def export(self, span: Span) -> None:
+        dropped = False
         with self._lock:
-            self._buf.append(self._span_payload(span))
-            should = len(self._buf) >= self.batch_size or (time.time() - self._last_flush) > self.flush_interval_s
-            if not should:
+            if self._closed:
                 return
-            batch, self._buf = self._buf, []
-            self._last_flush = time.time()
-        try:
-            self._send(batch)
-        except Exception as exc:  # noqa: BLE001 - exporting is best-effort
-            if self.logger is not None:
-                self.logger.debugf("trace export failed: %s", exc)
+            if len(self._buf) >= self.max_queue:
+                self.dropped_total += 1
+                dropped = True
+            else:
+                self._buf.append(self._span_payload(span))
+                if self._thread is None or not self._thread.is_alive():
+                    self._thread = threading.Thread(
+                        target=self._loop, name="trace-export", daemon=True)
+                    self._thread.start()
+        if dropped:
+            self._count_drop()
+        elif len(self._buf) >= self.batch_size:  # benign racy read: the
+            self._wake.set()                     # flusher re-checks under lock
+        return
+
+    def _count_drop(self) -> None:
+        if self.metrics is not None:
+            try:
+                self.metrics.increment_counter("app_obs_dropped_spans_total")
+            except Exception:  # noqa: BLE001 - self-observability best-effort
+                pass
+
+    def _loop(self) -> None:
+        while True:
+            self._wake.wait(timeout=self.flush_interval_s)
+            self._wake.clear()
+            while True:
+                with self._lock:
+                    closed = self._closed
+                    now = time.monotonic()
+                    due = bool(self._buf) and (
+                        self._force or closed
+                        or len(self._buf) >= self.batch_size
+                        or now - self._last_flush >= self.flush_interval_s)
+                    if due:
+                        batch, self._buf = self._buf, []
+                        self._last_flush = now
+                        self._sending = True
+                    else:
+                        self._force = False
+                        batch = None
+                if batch is None:
+                    break
+                try:
+                    self._send(batch)
+                except Exception as exc:  # noqa: BLE001 - best-effort
+                    if self.logger is not None:
+                        self.logger.debugf("trace export failed: %s", exc)
+                finally:
+                    with self._lock:
+                        self._sending = False
+            if closed:
+                return
+
+    def flush(self, timeout_s: float = 5.0) -> bool:
+        """Synchronously drain the queue (shutdown, tests). True when the
+        queue and any in-flight send finished within the timeout."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            with self._lock:
+                if not self._buf and not self._sending:
+                    return True
+                self._force = True
+                started = self._thread is not None and self._thread.is_alive()
+            if not started:  # nothing will ever drain it
+                return False
+            self._wake.set()
+            time.sleep(0.005)
+        return False
+
+    def close(self, timeout_s: float = 5.0) -> None:
+        """Flush remaining spans and stop the flusher thread."""
+        with self._lock:
+            self._closed = True
+            thread = self._thread
+        self._wake.set()
+        if thread is not None:
+            thread.join(timeout=timeout_s)
 
     def _send(self, batch: List[Dict[str, Any]]) -> None:
         """Transport; subclasses override (the gRPC exporter reuses the

@@ -302,5 +302,43 @@ def test_profiler_endpoint(tmp_path):
         assert status["active"] is False
         assert status["last_error"] is None
         assert status["last_dir"] == trace_dir  # xplane capture landed
+        assert status["last_trigger"] == "manual"
+        # monotonic-clock duration: ~the requested window, never negative
+        assert 0.5 <= status["last_duration_s"] <= 30.0
+    finally:
+        app.shutdown()
+
+
+def test_profiler_busy_answers_409(tmp_path):
+    """A second POST while a capture runs maps the profiler's busy
+    RuntimeError to HTTP 409 (one capture at a time: the profiler is a
+    process-global singleton), and status() reports the running capture's
+    trigger + monotonic age."""
+    import time as _time
+
+    app = make_app()
+    app.enable_profiler()
+    app.start()
+    try:
+        base = f"http://127.0.0.1:{app.http_port}"
+        r = requests.post(f"{base}/debug/profile",
+                          json={"seconds": 2.0, "dir": str(tmp_path)})
+        assert r.status_code == 202
+        busy = requests.post(f"{base}/debug/profile",
+                             json={"seconds": 1.0, "dir": str(tmp_path)})
+        assert busy.status_code == 409
+        assert "already running" in busy.json()["error"]["message"]
+        status = requests.get(f"{base}/debug/profile").json()["data"]
+        assert status["active"] is True
+        assert status["trigger"] == "manual"
+        assert status["seconds"] == 2.0
+        assert status["running_for_s"] >= 0.0
+        deadline = _time.time() + 30
+        while _time.time() < deadline:
+            status = requests.get(f"{base}/debug/profile").json()["data"]
+            if not status["active"]:
+                break
+            _time.sleep(0.05)
+        assert status["active"] is False  # leave the singleton idle
     finally:
         app.shutdown()

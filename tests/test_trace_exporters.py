@@ -37,6 +37,10 @@ def _finished_span(exporter, name="GET /x", attrs=None, ok=True):
     if not ok:
         span.set_status(False, "boom")
     span.end()
+    # transport runs on the exporter's daemon flusher thread now; drain
+    # it so the wire-shape assertions below see the POST
+    if hasattr(exporter, "flush"):
+        assert exporter.flush(timeout_s=10.0)
     return span
 
 
@@ -78,6 +82,77 @@ def test_otlp_http_wire_format(monkeypatch):
     assert attrs["s"] == {"stringValue": "x"}
     assert attrs["b"] == {"boolValue": True}
     assert otlp["startTimeUnixNano"].isdigit()
+
+
+def test_async_export_off_the_span_ending_thread(monkeypatch):
+    """export() must not POST on the caller thread: a collector that
+    blocks forever delays the flusher daemon, never the span-ending
+    (engine-loop / request) thread."""
+    import threading as _threading
+    import time as _time
+
+    posts = []
+    release = _threading.Event()
+    mod = types.ModuleType("requests")
+
+    def post(url, data=None, headers=None, timeout=None):
+        caller = _threading.current_thread()
+        release.wait(5)  # a wedged collector
+        posts.append((caller.name, json.loads(data)))
+
+    mod.post = post
+    monkeypatch.setitem(sys.modules, "requests", mod)
+    exporter = HTTPExporter("http://c/t", batch_size=1)
+    tracer = Tracer(exporter=exporter)
+    t0 = _time.monotonic()
+    tracer.start_span("fast").end()
+    assert _time.monotonic() - t0 < 1.0  # did NOT block on the collector
+    release.set()
+    assert exporter.flush(timeout_s=10.0)
+    (thread_name, body), = posts
+    assert thread_name == "trace-export"  # the daemon, not this thread
+    assert body[0]["name"] == "fast"
+    exporter.close()
+
+
+def test_export_queue_overflow_drops_and_counts(monkeypatch):
+    """A full queue sheds spans (bounded memory) and counts every drop in
+    app_obs_dropped_spans_total instead of blocking or growing."""
+    from gofr_tpu.metrics import Manager
+
+    block = _capture_posts(monkeypatch)  # noqa: F841 - wire the fake module
+    exporter = HTTPExporter("http://c/t", batch_size=10_000,
+                            flush_interval_s=3600.0, max_queue=8)
+    manager = Manager()
+    manager.new_counter("app_obs_dropped_spans_total", "spans dropped")
+    exporter.use_metrics(manager)
+    tracer = Tracer(exporter=exporter)
+    # stuff the queue past its bound before the flusher can possibly
+    # drain (nothing is due: huge batch size + interval)
+    for i in range(20):
+        tracer.start_span(f"s{i}").end()
+    assert exporter.dropped_total == 12
+    text = manager.expose()
+    assert "app_obs_dropped_spans_total 12.0" in text
+    exporter.close()
+
+
+def test_close_flushes_partial_batch(monkeypatch):
+    """Spans below the batch size and inside the flush interval still
+    reach the collector at close() — shutdown must not lose the tail."""
+    posts = _capture_posts(monkeypatch)
+    exporter = HTTPExporter("http://c/t", batch_size=64,
+                            flush_interval_s=3600.0)
+    tracer = Tracer(exporter=exporter)
+    tracer.start_span("tail-1").end()
+    tracer.start_span("tail-2").end()
+    assert posts == []  # nothing due yet
+    exporter.close()
+    (url, body), = posts
+    assert [s["name"] for s in body] == ["tail-1", "tail-2"]
+    # a closed exporter rejects new spans instead of queueing forever
+    tracer.start_span("late").end()
+    assert len(posts) == 1
 
 
 def test_exporter_from_config_selects_wire_formats():

@@ -332,3 +332,39 @@ def test_metric_inventory_consistency():
     assert not undocumented, (
         f"metrics recorded in gofr_tpu/tpu/ but missing from "
         f"docs/observability.md: {sorted(undocumented)}")
+
+
+# -- endpoint-inventory consistency gate --------------------------------------
+# route registrations: app.get/post defaults and install_routes path
+# defaults all carry the literal ("/debug/<name>")
+_DEBUG_ROUTE = re.compile(r'["\'](/debug/[a-z_]+)')
+
+
+def test_debug_endpoint_inventory_documented():
+    """Every /debug/* operator route registered anywhere in gofr_tpu
+    (app.py + the tpu modules' install_routes) must appear in
+    docs/observability.md — the endpoint sibling of the metric gate, so
+    a new operator surface cannot ship undocumented."""
+    pkg = os.path.join(os.path.dirname(__file__), "..", "gofr_tpu")
+    sources = [os.path.join(pkg, "app.py")]
+    tpu_dir = os.path.join(pkg, "tpu")
+    sources += [os.path.join(tpu_dir, f) for f in sorted(os.listdir(tpu_dir))
+                if f.endswith(".py")]
+    routes = set()
+    for path in sources:
+        with open(path, encoding="utf-8") as fp:
+            routes.update(_DEBUG_ROUTE.findall(fp.read()))
+    # regex-rot guard: the known surfaces must all be in the scan
+    for expected in ("/debug/profile", "/debug/requests", "/debug/engine",
+                     "/debug/steps", "/debug/faults", "/debug/slo",
+                     "/debug/incidents"):
+        assert expected in routes, f"scan missed {expected} (regex rot?)"
+
+    docs = os.path.join(os.path.dirname(__file__), "..", "docs",
+                        "observability.md")
+    with open(docs, encoding="utf-8") as fp:
+        text = fp.read()
+    undocumented = {r for r in routes if r not in text}
+    assert not undocumented, (
+        f"/debug routes registered in gofr_tpu but missing from "
+        f"docs/observability.md: {sorted(undocumented)}")

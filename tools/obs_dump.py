@@ -10,9 +10,12 @@ re-reproduced.
 
 Each line also carries the fleet-level `/debug/engine` snapshot (slots,
 page pool, utilization window — MFU/MBU/duty-cycle — and compile-cache
-totals) and the `/debug/steps` anatomy summary (per-phase step-time
-baselines, segment totals, recent stragglers), so soak artifacts gain an
-efficiency axis and a step-anatomy axis next to the tail evidence.
+totals), the `/debug/steps` anatomy summary (per-phase step-time
+baselines, segment totals, recent stragglers), the `/debug/slo`
+burn-rate readout (per-SLO fast/slow burn + alert state — the paging
+signal), and the `/debug/incidents` index (auto-captured evidence
+bundles + suppression counts), so soak artifacts gain efficiency,
+step-anatomy, and error-budget axes next to the tail evidence.
 
 Usage:
     python tools/obs_dump.py [--server http://127.0.0.1:8000]
@@ -103,6 +106,33 @@ def poll_once(server: str, metrics_base: str) -> dict:
         }
     except Exception as exc:  # noqa: BLE001 - older servers lack the route
         entry["steps_error"] = str(exc)
+    try:
+        body = json.loads(_get(server.rstrip("/") + "/debug/slo"))
+        snap = body.get("data", body)
+        # per-SLO alert states + burn rates are the paging signal; keep
+        # the transitions trail so a flap is reconstructable
+        entry["slo_burn"] = {
+            "slos": {
+                name: {"state": slo.get("state"),
+                       "burn_fast": slo["windows"]["fast"].get("burn_rate"),
+                       "burn_slow": slo["windows"]["slow"].get("burn_rate")}
+                for name, slo in (snap.get("slos") or {}).items()},
+            "transitions": snap.get("transitions", [])[-5:],
+        }
+    except Exception as exc:  # noqa: BLE001 - older servers lack the route
+        entry["slo_burn_error"] = str(exc)
+    try:
+        body = json.loads(_get(server.rstrip("/") + "/debug/incidents"))
+        snap = body.get("data", body)
+        entry["incidents"] = {
+            "captured_total": snap.get("captured_total"),
+            "triggers": snap.get("triggers"),
+            "suppressed": snap.get("suppressed"),
+            # metadata only — the bundles themselves live in INCIDENT_DIR
+            "recent": snap.get("incidents", [])[:5],
+        }
+    except Exception as exc:  # noqa: BLE001 - older servers lack the route
+        entry["incidents_error"] = str(exc)
     try:
         entry["gauges"] = scrape_gauges(metrics_base)
     except Exception as exc:  # noqa: BLE001

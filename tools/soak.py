@@ -35,7 +35,7 @@ import time
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 
-def _build(profile: str, preset: str):
+def _build(profile: str, preset: str, chaos: bool = False):
     import dataclasses
 
     from gofr_tpu.models.llama import LlamaConfig, llama_init, quantize_weights
@@ -50,6 +50,13 @@ def _build(profile: str, preset: str):
         prefill_buckets=(16, 32, 64) if small else (64, 128, 256, 512),
         decode_block_size=4 if small else 16,
     )
+    if chaos:
+        # tightened breaker so the injected failure pair clusters into a
+        # REAL reset storm: breaker opens (503 sheds, incident capture),
+        # the half-open probe closes it ~2 s later, traffic resumes —
+        # the full crash-only arc inside one soak
+        kw.update(retry_budget=4, reset_storm_max=2,
+                  reset_storm_window_s=60.0, breaker_cooldown_s=2.0)
     if profile == "mixed":
         cfg = dataclasses.replace(
             cfg, attn_impl=cfg.attn_impl if small else "flash",
@@ -73,7 +80,7 @@ def _build(profile: str, preset: str):
 
 
 def _soak(engine, seconds: float, n_threads: int, vocab: int) -> dict:
-    stats = {"ok": 0, "cancelled": 0, "errors": 0, "tokens": 0}
+    stats = {"ok": 0, "cancelled": 0, "errors": 0, "shed": 0, "tokens": 0}
     errors = []
     lock = threading.Lock()
     stop_at = time.time() + seconds
@@ -121,9 +128,18 @@ def _soak(engine, seconds: float, n_threads: int, vocab: int) -> dict:
                 with lock:
                     stats["tokens"] += got
             except Exception as exc:  # noqa: BLE001 - the soak gate itself
-                with lock:
-                    stats["errors"] += 1
-                    errors.append(repr(exc))
+                if getattr(exc, "status_code", None) == 503:
+                    # a breaker/stall shed is back-pressure, not a
+                    # failure: the client waits out the Retry-After hint
+                    # and retries — counted separately from errors
+                    with lock:
+                        stats["shed"] += 1
+                    time.sleep(min(
+                        getattr(exc, "retry_after_s", None) or 1.0, 2.0))
+                else:
+                    with lock:
+                        stats["errors"] += 1
+                        errors.append(repr(exc))
             time.sleep(rng.expovariate(8.0))
 
     threads = [threading.Thread(target=worker, args=(i,), daemon=True)
@@ -137,10 +153,14 @@ def _soak(engine, seconds: float, n_threads: int, vocab: int) -> dict:
 
 
 # the mid-soak chaos schedule (--chaos): two injected decode-dispatch
-# failures, far enough apart that the engine fully recovers between them.
+# failures close enough together (the chaos engine is built with
+# reset_storm_max=2) that they open the reset-storm breaker — the full
+# crash-only arc: resets -> replay -> breaker open (incident bundle
+# auto-captured, submits shed 503) -> half-open probe -> recovery.
 # Deterministic per --chaos-seed; recovery evidence (resets, replays,
-# failed requests — expected 0 within the retry budget) lands in the
-# JSON artifact next to the throughput numbers.
+# incidents, burn-rate peaks, failed requests — expected 0 within the
+# retry budget) lands in the JSON artifact next to the throughput
+# numbers.
 CHAOS_PLAN = [
     {"site": "engine.decode", "every": 40, "times": 2, "action": "raise"},
 ]
@@ -150,20 +170,36 @@ def run_profile(profile: str, seconds: float, n_threads: int,
                 preset: str, chaos: bool = False, chaos_seed: int = 0) -> bool:
     from gofr_tpu.tpu.flightrecorder import FlightRecorder
 
-    engine = _build(profile, preset)
+    engine = _build(profile, preset, chaos=chaos)
     # flight recorder: the soak's per-request TAIL evidence — the slowest
     # completions' phase timings + SLO goodput land in the JSON artifact,
     # so a blown-tail run is diagnosable without re-reproduction
     engine.recorder = recorder = FlightRecorder(capacity=512)
     chaos_armed_at = None
+    incidents = None
+    burn = None
     if chaos:
+        import tempfile
+
         from gofr_tpu.tpu.faults import FaultPlane
+        from gofr_tpu.tpu.incidents import IncidentManager, SLOBurnEngine
 
         # attach DISARMED (empty plan: one attribute check + an early
         # return per dispatch), then arm the seeded schedule mid-soak so
         # recovery runs under real concurrent load, not a cold engine
         plane = FaultPlane(seed=chaos_seed)
         engine.faults = plane
+        # the autopsy plane rides along: the storm must auto-capture a
+        # breaker_open evidence bundle (gated below) and the burn engine
+        # records how hard the SLOs burned through it
+        burn = SLOBurnEngine(min_events=8)
+        recorder.use_burn_engine(burn)
+        incidents = IncidentManager(
+            engine=engine, recorder=recorder,
+            dir=tempfile.mkdtemp(prefix="gofr-soak-incidents-"),
+            cooldown_s=5.0)
+        burn.on_page = incidents.on_slo_page
+        engine.incidents = incidents
         chaos_armed_at = max(1.0, seconds / 3.0)
         arm_timer = threading.Timer(
             chaos_armed_at, lambda: plane.arm(CHAOS_PLAN, seed=chaos_seed))
@@ -196,6 +232,10 @@ def run_profile(profile: str, seconds: float, n_threads: int,
             after = [f for f in finishes if f >= last_reset]
             if after:
                 ttr = round(after[0] - last_reset, 3)
+        # incident autopsy evidence: drain outstanding captures, then
+        # embed the index + the storm's burn-rate peaks in the artifact
+        incidents.wait_idle(timeout_s=30.0)
+        incident_index = incidents.index()
         stats["chaos"] = {
             "plan": CHAOS_PLAN, "seed": chaos_seed,
             "armed_at_s": round(chaos_armed_at, 1),
@@ -205,7 +245,10 @@ def run_profile(profile: str, seconds: float, n_threads: int,
             "quarantined": engine.quarantined_total,
             "breaker": engine.breaker.snapshot(),
             "failed_requests": stats["errors"],  # gate: 0 within budget
+            "sheds": stats["shed"],  # breaker-open 503s (expected > 0)
             "time_to_recover_s": ttr,
+            "incidents": incident_index,
+            "slo_burn_peaks": burn.peaks(),
         }
     # efficiency axis (tpu/utilization.py): final MFU/MBU/duty-cycle so
     # BENCH_*.json judges throughput AGAINST the hardware roofline, not
@@ -243,6 +286,17 @@ def run_profile(profile: str, seconds: float, n_threads: int,
     with_ttft = [r for r in snap["recent"] if "ttft_s" in r]
     stats["slowest_ttft"] = sorted(with_ttft, key=lambda r: -r["ttft_s"])[:5]
     ok = stats["errors"] == 0 and drained and stats["ok"] > 0
+    if chaos:
+        # the storm must have tripped the breaker AND the trip must have
+        # auto-captured its evidence bundle — telemetry that only works
+        # when nobody needs it is not telemetry
+        chaos_evidence = stats["chaos"]["incidents"]
+        breaker_incidents = sum(
+            1 for b in chaos_evidence["incidents"]
+            if b["trigger"] == "breaker_open")
+        stats["chaos"]["breaker_open_incidents"] = breaker_incidents
+        ok = ok and breaker_incidents >= 1 \
+            and stats["chaos"]["breaker"]["state"] == "closed"
     leaked = None
     if hasattr(engine, "allocator"):
         prefix = getattr(engine, "prefix", None)
