@@ -716,6 +716,31 @@ def main() -> None:
                 out[key] = round(hist.percentile(0.5) * 1e3, 2)
         return out
 
+    def _step_segments(eng):
+        """Per-segment share of decode-step wall from the step ledger
+        (/debug/steps): where the loop thread spends its time. Keyed into
+        the headline extras so host-overhead shifts (async D2H, demux
+        vectorization, off-loop finishing) show up in the BENCH trajectory,
+        not just interactively."""
+        try:
+            summary = eng.steps.snapshot()["summary"].get("decode")
+        except Exception:  # noqa: BLE001 — diagnostics never fail the bench
+            return {}
+        if not summary or not summary.get("wall_s"):
+            return {}
+        wall = summary["wall_s"]
+        shares = {seg: round(s / wall, 4)
+                  for seg, s in summary["segments"].items()}
+        return {"step_segments": {
+            "steps": summary["steps"],
+            "wall_s": round(wall, 3),
+            "shares": shares,
+            # the host tax the tentpole attacks, as one number
+            "loop_host_share": round(sum(
+                shares.get(k, 0.0)
+                for k in ("device_sync", "demux", "emit", "host_prep")), 4),
+        }}
+
     def make_engine(slots, seq, use_cfg, cls=LLMEngine, **extra):
         # block/depth from a sweep on v5e: small blocks turn finished slots
         # over faster; depth 2 hides dispatch latency without inflating the
@@ -809,6 +834,7 @@ def main() -> None:
                   t0_elapsed_s=round(elapsed, 2),
                   slots=engine.n_slots,
                   **_engine_percentiles(),
+                  **_step_segments(engine),
                   **({"roofline_tok_s": round(roofline_tok_s, 1),
                       "model_gib": round(params_bytes(cfg) / 2**30, 2),
                       "t0_cache_len": engine._cache_len,

@@ -220,6 +220,12 @@ def build_engine(app: App, default_sampling_controls: bool = False) -> LLMEngine
         reset_storm_window_s=app.config.get_float("RESET_STORM_WINDOW_S",
                                                   60.0),
         breaker_cooldown_s=app.config.get_float("BREAKER_COOLDOWN_S", 5.0),
+        # decode hot-loop host teardown: start D2H token copies at
+        # dispatch time (sync becomes a completion check) and run
+        # terminal-slot teardown on a bounded off-loop finisher
+        # (ENGINE_FINISHER_QUEUE=0 restores fully-inline finishing)
+        async_d2h=app.config.get_bool("ENGINE_ASYNC_D2H", True),
+        finisher_queue=app.config.get_int("ENGINE_FINISHER_QUEUE", 256),
         **paged_kw,
     )
     engine.tokenizer = tokenizer

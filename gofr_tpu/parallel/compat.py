@@ -1,0 +1,50 @@
+"""jax API compatibility shims for the parallel package.
+
+shard_map moved over jax releases: old releases expose it only at
+``jax.experimental.shard_map.shard_map`` with a ``check_rep`` kwarg;
+newer ones promote it to ``jax.shard_map`` and rename the replication
+check to ``check_vma``. Call sites here (ring attention, sequence
+parallelism, pipeline microbatching, and their tests) target the new
+spelling; this shim routes to whichever the installed jax provides so
+the package imports and runs on both sides of the rename.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def axis_size(axis_name) -> int:
+    """Static size of a mapped mesh axis, inside a shard_map body.
+
+    ``jax.lax.axis_size`` is the new public spelling; older releases
+    only expose the axis environment through ``jax.core.axis_frame``,
+    which (depending on release) returns either the frame object or the
+    size itself. The result is a concrete Python int either way — call
+    sites use it for static loop bounds and reshape dims."""
+    fn = getattr(jax.lax, "axis_size", None)
+    if fn is not None:
+        return fn(axis_name)
+    frame = jax.core.axis_frame(axis_name)
+    return int(getattr(frame, "size", frame))
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None, **kw):
+    """``jax.shard_map`` with the new-style signature on any jax.
+
+    ``check_vma`` (new name) maps onto ``check_rep`` (old name) when
+    falling back to the experimental module; None means "whatever the
+    installed jax defaults to".
+    """
+    native = getattr(jax, "shard_map", None)
+    if native is not None:
+        if check_vma is not None:
+            kw["check_vma"] = check_vma
+        return native(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, **kw)
+    from jax.experimental.shard_map import shard_map as exp_shard_map
+
+    if check_vma is not None:
+        kw["check_rep"] = check_vma
+    return exp_shard_map(f, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, **kw)

@@ -232,9 +232,21 @@ class Executor:
                 raise ValueError(f"device ids {device_ids} not all present")
             execution_devices = ([by_id[i] for i in device_ids]
                                  if device_ids else jax.devices()[:1])
-            compiled = serialize_executable.deserialize_and_load(
-                blob, in_tree, out_tree,
-                execution_devices=execution_devices)
+            import inspect
+            params = inspect.signature(
+                serialize_executable.deserialize_and_load).parameters
+            if "execution_devices" in params:
+                compiled = serialize_executable.deserialize_and_load(
+                    blob, in_tree, out_tree,
+                    execution_devices=execution_devices)
+            else:
+                # jax 0.4.x: no execution_devices kwarg — the PJRT blob
+                # carries its own device assignment, which load() restores
+                # through the backend client; the device-id presence check
+                # above still discards artifacts from a changed topology
+                compiled = serialize_executable.deserialize_and_load(
+                    blob, in_tree, out_tree,
+                    backend=execution_devices[0].client)
         except Exception as exc:  # noqa: BLE001 - stale/foreign artifact
             if self.logger is not None:
                 self.logger.warnf("discarding persisted program %s: %s",

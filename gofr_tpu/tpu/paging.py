@@ -325,11 +325,16 @@ class PagedLLMEngine(LLMEngine):
                 self.allocator.release(slot.pages)
             slot.pages = None
         super()._finish_slot(slot)
-        self._obs.gauge("app_tpu_pages_used", self.allocator.used_pages)
-        self._obs.gauge("app_tpu_kv_pool_pages", self.allocator.used_pages,
-                        kind="used")
-        self._obs.gauge("app_tpu_kv_pool_pages", self.allocator.free_pages,
-                        kind="free")
+        # pool gauges ride the off-loop finisher: values are READ here on
+        # the loop thread (allocator state is loop-owned), flushed off it
+        used, free = self.allocator.used_pages, self.allocator.free_pages
+
+        def flush() -> None:
+            self._obs.gauge("app_tpu_pages_used", used)
+            self._obs.gauge("app_tpu_kv_pool_pages", used, kind="used")
+            self._obs.gauge("app_tpu_kv_pool_pages", free, kind="free")
+
+        self._run_off_loop(flush)
 
     # -- programs -------------------------------------------------------------
     def warmup(self, grow: bool = True, k_variants: bool = False) -> None:
@@ -1174,6 +1179,7 @@ class PagedLLMEngine(LLMEngine):
                         self._temps, self.rng)
         except Exception as exc:
             raise CacheLostError(f"paged decode dispatch failed: {exc}") from exc
+        self._start_d2h(out_tokens)
         dspan = self._dispatch_span("tpu.decode", next(self._batch_seq),
                                     **{"batch.size": len(snapshot),
                                        "tpu.block": block,

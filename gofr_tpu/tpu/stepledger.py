@@ -32,9 +32,16 @@ explicit ``other`` residual means nothing can hide):
                    those sites
   ``device_sync``  blocking host sync on the oldest in-flight dispatch —
                    the segment that grows when the device (or transport)
-                   is the problem
-  ``emit``         post-sync demux: per-token emission, recorder/metric
-                   callbacks, slot bookkeeping
+                   is the problem. With async D2H (copy_to_host_async at
+                   dispatch time, the engine default) this is a transfer
+                   COMPLETION check, not the transfer itself
+  ``demux``        post-sync token routing math: the vectorized stop-scan
+                   / budget / context-cap pass over the synced
+                   ``[B, block]`` token matrix that decides how many
+                   tokens each live row emits and which slots go terminal
+  ``emit``         post-sync delivery: batched per-request out_queue
+                   puts, replay-ledger append, recorder/metric callbacks,
+                   slot bookkeeping and hot-path slot reset
   ``other``        everything not wrapped above (the residual that makes
                    the sum identity hold)
 
@@ -71,7 +78,7 @@ from typing import Any, Dict, List, Optional
 from .obs import MetricsHook
 
 SEGMENTS = ("admission", "page_alloc", "host_prep", "compile", "cache_grow",
-            "dispatch", "device_sync", "emit", "other")
+            "dispatch", "device_sync", "demux", "emit", "other")
 
 # step phases, by what the iteration synced (one sync per iteration) or,
 # sync-less, what it dispatched

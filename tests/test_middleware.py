@@ -1,6 +1,8 @@
 import json
 import time
 
+import pytest
+
 from gofr_tpu.http import middleware as mw
 from gofr_tpu.http.request import Request
 from gofr_tpu.http.responder import Response
@@ -98,9 +100,14 @@ def test_api_key_auth():
 
 
 def _make_rsa_jwks():
-    """RSA keypair + JWKS doc + an RS256 signer, via `cryptography`."""
+    """RSA keypair + JWKS doc + an RS256 signer, via `cryptography`.
+
+    `cryptography` is an optional test dependency (pyproject "test"
+    extra): environments without it skip the RS256/JWKS tests instead
+    of erroring — the middleware itself never imports it."""
     import base64
 
+    pytest.importorskip("cryptography")
     from cryptography.hazmat.primitives import hashes
     from cryptography.hazmat.primitives.asymmetric import padding, rsa
 

@@ -9,7 +9,8 @@ from jax.sharding import NamedSharding, PartitionSpec
 
 from gofr_tpu.models.llama import LlamaConfig, llama_forward_nocache, llama_init
 from gofr_tpu.models.moe import MoELlamaConfig, moe_llama_forward_nocache, moe_llama_init
-from gofr_tpu.parallel import MeshPlan, batch_spec, llama_param_specs, make_mesh, shard_params
+from gofr_tpu.parallel import (MeshPlan, batch_spec, llama_param_specs,
+                               make_mesh, shard_map, shard_params)
 from gofr_tpu.train import make_train_step
 
 
@@ -100,7 +101,7 @@ def test_ring_attention_matches_full_attention():
 
     mesh = make_mesh(MeshPlan(sp=8))
     spec = PartitionSpec(None, "sp", None, None)
-    ring = jax.jit(jax.shard_map(
+    ring = jax.jit(shard_map(
         lambda q, k, v: ring_attention(q, k, v, axis_name="sp"),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False))
@@ -122,7 +123,7 @@ def test_ring_attention_differentiable():
     spec = PartitionSpec(None, "sp", None, None)
 
     def loss(q, k, v):
-        out = jax.shard_map(
+        out = shard_map(
             lambda q, k, v: ring_attention(q, k, v, axis_name="sp"),
             mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
             check_vma=False)(q, k, v)
@@ -147,6 +148,9 @@ def test_moe_forward_and_aux_loss():
     assert 0.5 < float(aux) < 4.0
 
 
+@pytest.mark.slow  # heavyweight shard_map train-step compile: the
+# forward/parity coverage for this topology stays in tier-1; the
+# train step runs in the slow lane
 def test_moe_ep_sharded_train_step():
     """MoE train step with experts sharded over ep: compiles + loss falls."""
     params = moe_llama_init(MOE_CFG, seed=0)
@@ -173,6 +177,9 @@ def test_moe_ep_sharded_train_step():
     assert len(spec) >= 2 and spec[1] == "ep" 
 
 
+@pytest.mark.slow  # heavyweight shard_map train-step compile: the
+# forward/parity coverage for this topology stays in tier-1; the
+# train step runs in the slow lane
 def test_pipeline_forward_matches_and_trains():
     """pp=4 GPipe forward == plain forward; grads flow through the pipeline."""
     from gofr_tpu.parallel.pipeline import pipelined_llama_forward
@@ -219,7 +226,7 @@ def test_ulysses_attention_matches_full_attention():
 
     mesh = make_mesh(MeshPlan(sp=8))
     spec = PartitionSpec(None, "sp", None, None)
-    fn = jax.jit(jax.shard_map(
+    fn = jax.jit(shard_map(
         lambda q, k, v: ulysses_attention(q, k, v, axis_name="sp"),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False))
@@ -241,7 +248,7 @@ def test_ulysses_matches_ring():
     spec = PartitionSpec(None, "sp", None, None)
 
     def wrap(fn):
-        return jax.jit(jax.shard_map(
+        return jax.jit(shard_map(
             lambda q, k, v: fn(q, k, v, axis_name="sp"),
             mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
             check_vma=False))
@@ -264,7 +271,7 @@ def test_ulysses_differentiable():
     spec = PartitionSpec(None, "sp", None, None)
 
     def loss(q, k, v):
-        out = jax.shard_map(
+        out = shard_map(
             lambda q, k, v: ulysses_attention(q, k, v, axis_name="sp"),
             mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
             check_vma=False)(q, k, v)
@@ -282,7 +289,7 @@ def test_ulysses_rejects_indivisible_heads():
     spec = PartitionSpec(None, "sp", None, None)
     q = jnp.ones((1, 16, 6, 8))  # 6 heads not divisible by sp=8
     with pytest.raises(ValueError, match="divide"):
-        jax.shard_map(
+        shard_map(
             lambda q, k, v: ulysses_attention(q, k, v, axis_name="sp"),
             mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
             check_vma=False)(q, q, q)
@@ -306,6 +313,9 @@ def test_sp_llama_forward_matches_dense():
                                    rtol=2e-4, atol=2e-4, err_msg=attn)
 
 
+@pytest.mark.slow  # heavyweight shard_map train-step compile: the
+# forward/parity coverage for this topology stays in tier-1; the
+# train step runs in the slow lane
 def test_sp_llama_forward_trains():
     from gofr_tpu.parallel.longcontext import make_sp_forward
 

@@ -8,6 +8,7 @@ token leakage). Failures here are race symptoms even without a sanitizer.
 """
 
 import threading
+import time
 
 import numpy as np
 
@@ -347,21 +348,32 @@ def test_engine_stop_with_wedged_loop_leaves_state_to_live_loop():
     eng.STOP_JOIN_S = 0.2
     eng.start()
     eng.generate([1, 2, 3], max_new_tokens=3)  # warm
+    # quiesce: the warm request's surplus pipelined decodes are still in
+    # flight when generate() returns; they must drain BEFORE the wedge is
+    # armed, or the wedged iteration holds only junk entries and the new
+    # request's decodes never dispatch (the old flake: whether result()
+    # below sees 4 tokens then depended on where stop() landed)
+    deadline = time.time() + 30
+    while eng._inflight and time.time() < deadline:
+        time.sleep(0.01)
+    assert not eng._inflight, "warm-up dispatches never drained"
 
     gate = threading.Event()
+    entered = threading.Event()
     orig_sync = eng._sync_oldest
 
     def stuck_sync():
+        entered.set()   # the loop is now provably INSIDE the device call
         gate.wait(timeout=30)
         return orig_sync()
 
-    import time
-
     eng._sync_oldest = stuck_sync
     req = eng.submit([4, 5, 6], max_new_tokens=4)
-    deadline = time.time() + 30
-    while not eng._inflight and time.time() < deadline:
-        time.sleep(0.01)
+    # deterministic wedge: wait for the loop to ENTER the gated sync (the
+    # same iteration already dispatched the request's prefill + pipelined
+    # decodes), not for _inflight to appear — stop() could otherwise land
+    # on a not-yet-wedged loop and join cleanly
+    assert entered.wait(timeout=30), "loop never reached the gated sync"
 
     eng.stop()  # join times out against the gated sync
     assert eng._thread is not None, "stop() nulled a live loop thread"

@@ -343,6 +343,69 @@ def test_engine_pipelined_matches_synchronous():
     assert run(1, 1) == run(4, 3)
 
 
+def test_stream_ordering_with_cancels_mid_block():
+    """Batched emission contract: with block-sized queue entries, pipelined
+    dispatches and cancels landing mid-block, every client still receives
+    exactly `request.emitted`, in order, with the terminal `None` strictly
+    last — the invariant the PR-3 replay ledger and SSE streaming build on."""
+    from gofr_tpu.models.llama import LlamaConfig, llama_init
+    from gofr_tpu.tpu.engine import LLMEngine
+
+    cfg = LlamaConfig.debug()
+    params = llama_init(cfg, seed=0)
+    eng = LLMEngine(params, cfg, n_slots=4, max_seq_len=64,
+                    prefill_buckets=(8,), decode_block_size=4,
+                    pipeline_depth=2)
+    eng.start()
+    try:
+        prompts = [[i + 1, i + 2, i + 3] for i in range(6)]
+        reqs = [eng.submit(p, max_new_tokens=40, temperature=0.0)
+                for p in prompts]
+        results, errors = {}, []
+
+        def consume(idx, req, cancel_after):
+            # raw out_queue, not stream(): the terminal-None placement and
+            # the batched list entries are exactly what's under test
+            try:
+                got = []
+                while True:
+                    entry = req.out_queue.get(timeout=120)
+                    if entry is None:
+                        break
+                    got.extend(entry if type(entry) is list else [entry])
+                    if cancel_after and len(got) >= cancel_after:
+                        req.cancel()
+                        cancel_after = 0
+                results[idx] = got
+            except Exception as exc:  # noqa: BLE001 - surfaced in main thread
+                errors.append((idx, exc))
+
+        threads = [threading.Thread(target=consume, args=(i, r, 3 if i % 2 else 0))
+                   for i, r in enumerate(reqs)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=180)
+        assert not errors, errors
+        assert len(results) == len(reqs)
+        for i, req in enumerate(reqs):
+            # delivered == ledger, element for element and in order
+            assert results[i] == req.emitted, f"request {i} stream != emitted"
+            assert req.generated == len(req.emitted)
+            assert req.finished_at is not None
+            # None was terminal: nothing trails it on the queue
+            assert req.out_queue.empty()
+            if i % 2:  # cancelled mid-block: cut short, but never empty
+                assert 1 <= len(results[i]) < 40
+            else:
+                assert len(results[i]) == 40
+        # uncancelled streams carry the true greedy continuation in order
+        check = eng.generate(prompts[0], max_new_tokens=40, temperature=0.0)
+        assert results[0] == check
+    finally:
+        eng.stop()
+
+
 def test_engine_admission_split():
     from gofr_tpu.tpu.engine import _admission_split
 
