@@ -1046,6 +1046,86 @@ def main() -> None:
         record.update(http_error=f"{type(exc).__name__}: {exc}"[:200])
         _note_wedge(exc, record, "H")
 
+    # ---- KV: tiered prefix cache — TTFT on tier hit vs miss (labeled extra)
+    # The tentpole claim is "a re-sent prefix pays an H2D copy instead of a
+    # re-prefill even after HBM pressure evicted it". Measure exactly that:
+    # boot a SMALL paged engine (tiny page pool so eviction is organic, host
+    # tier on), TTFT a cold trunk (miss = full prefill), push filler traffic
+    # through until the trunk's pages spill to host RAM, then re-send the
+    # trunk with a fresh tail (hit = restore + tail-only prefill). Shares
+    # params with the live engine, same as the T0v candidates.
+    try:
+        if full_run and _left() > 300 and not _WEDGED:
+            from gofr_tpu.tpu.paging import PagedLLMEngine
+
+            kv_ps = 64
+            kv_eng = make_engine(4, min(1024, max_seq), cfg,
+                                 cls=PagedLLMEngine, page_size=kv_ps,
+                                 n_pages=48, prefix_cache=True,
+                                 kv_host_tier_bytes=256 << 20)
+            try:
+                def _kv_ttft(toks):
+                    req = kv_eng.submit(toks, max_new_tokens=8,
+                                        temperature=0.0)
+                    req.result(timeout_s=TOKEN_TIMEOUT_S)
+                    return (req.first_token_at - req.enqueued_at) * 1e3
+
+                trunk = rng.integers(1, cfg.vocab_size,
+                                     size=6 * kv_ps).tolist()
+
+                def _tail():
+                    return rng.integers(1, cfg.vocab_size, size=16).tolist()
+
+                # warm the prefill bucket + decode programs off the clock
+                _kv_ttft(rng.integers(1, cfg.vocab_size,
+                                      size=len(trunk) + 16).tolist())
+                ttft_miss_ms = _kv_ttft(trunk + _tail())
+                # filler rounds cycle the 48-page pool so the idle trunk
+                # pages evict -> spill; stop as soon as the spill shows up
+                for _ in range(6):
+                    fill = [kv_eng.submit(
+                        rng.integers(1, cfg.vocab_size,
+                                     size=6 * kv_ps + 16).tolist(),
+                        max_new_tokens=8, temperature=0.0)
+                        for _ in range(4)]
+                    for r in fill:
+                        r.result(timeout_s=TOKEN_TIMEOUT_S)
+                    if kv_eng._kv_spilled >= 6:
+                        break
+                restored_before = kv_eng._kv_restored
+                ttft_hit_ms = _kv_ttft(trunk + _tail())
+                restored = kv_eng._kv_restored - restored_before
+                tokens_avoided = restored * kv_ps
+                # dominant prefill cost is the 2*params matmul work per
+                # token; attention's quadratic term is small at this length
+                gflops_avoided = 2 * cfg.param_count() * tokens_avoided / 1e9
+                tier_stats = kv_eng.kv_tier.stats()
+                print(f"[bench] KV tier: ttft miss {ttft_miss_ms:.1f}ms vs "
+                      f"hit {ttft_hit_ms:.1f}ms (restored {restored} pages, "
+                      f"{tokens_avoided} prefill tok avoided, "
+                      f"spilled {kv_eng._kv_spilled}) t={_spent():.0f}s",
+                      file=sys.stderr)
+                record.update(
+                    kv_tier_ttft_miss_ms=round(ttft_miss_ms, 1),
+                    kv_tier_ttft_hit_ms=round(ttft_hit_ms, 1),
+                    kv_tier_ttft_win_ms=round(ttft_miss_ms - ttft_hit_ms, 1),
+                    kv_tier_restored_pages=restored,
+                    kv_tier_spilled_pages=kv_eng._kv_spilled,
+                    kv_tier_prefill_tokens_avoided=tokens_avoided,
+                    kv_tier_prefill_gflops_avoided=round(gflops_avoided, 1),
+                    kv_tier_host_hits=tier_stats["hits"],
+                    kv_tier_host_used_bytes=tier_stats["used_bytes"])
+            finally:
+                kv_eng.stop()
+        elif full_run:
+            record.update(kv_tier_skipped=("device wedged" if _WEDGED
+                                           else "budget"))
+    except Exception as exc:  # noqa: BLE001 - keep earlier phases' record
+        print(f"[bench] KV tier phase failed (earlier results preserved): "
+              f"{exc}", file=sys.stderr)
+        record.update(kv_tier_error=f"{type(exc).__name__}: {exc}"[:200])
+        _note_wedge(exc, record, "KV")
+
     # ---- T2: structured-text speculation (labeled extra, never headline) --
     # Speculative decoding cannot help the random-token phases (no self-
     # repetition to draft from), so measure it on an honest STRUCTURED

@@ -26,7 +26,8 @@ pin_platform_from_env()
 from gofr_tpu import App, Stream  # noqa: E402
 from gofr_tpu.http.errors import InvalidParam, ServiceUnavailable  # noqa: E402
 from gofr_tpu.models.llama import LlamaConfig, llama_init  # noqa: E402
-from gofr_tpu.models.tokenizer import ByteTokenizer, StreamingDecoder  # noqa: E402
+from gofr_tpu.models.tokenizer import (ByteTokenizer, DebugTokenizer,  # noqa: E402
+                                       StreamingDecoder)
 from gofr_tpu.tpu.device import TPUClient  # noqa: E402
 from gofr_tpu.tpu.engine import LLMEngine  # noqa: E402
 from gofr_tpu.tpu.executor import Executor  # noqa: E402
@@ -118,6 +119,11 @@ def build_engine(app: App, default_sampling_controls: bool = False) -> LLMEngine
         app.logger.infof("loaded vocab from %s (%s, %d tokens)",
                          vocab_path, type(tokenizer).__name__,
                          tokenizer.vocab_size)
+    elif cfg.vocab_size > ByteTokenizer.vocab_size:
+        # synthetic presets (debug: vocab_size=512) sample ids the byte
+        # tokenizer cannot round-trip (>=256 dropped, random bytes form
+        # invalid UTF-8); DebugTokenizer decodes every id to one char
+        tokenizer = DebugTokenizer(cfg.vocab_size)
     else:
         tokenizer = ByteTokenizer()
     if cfg.vocab_size < tokenizer.vocab_size:
@@ -177,6 +183,27 @@ def build_engine(app: App, default_sampling_controls: bool = False) -> LLMEngine
         # (system prompts re-prefill once, not per request); int8 pools
         # share their scale pages alongside
         paged_kw["prefix_cache"] = app.config.get_bool("PREFIX_CACHE", True)
+        # KV_HOST_TIER_BYTES>0 adds a host-RAM tier under the prefix
+        # cache: evicted refs==0 pages spill to pinned host blobs and
+        # restore via one H2D scatter at admission, so a re-sent prefix
+        # pays a copy instead of a re-prefill even after HBM pressure
+        # evicted it. KV_REDIS_TIER=true chains a write-behind Redis cold
+        # tier below host RAM (blobs versioned + checksummed; any
+        # corruption degrades to a miss, never wrong KV)
+        tier_bytes = app.config.get_int("KV_HOST_TIER_BYTES", 0)
+        if tier_bytes > 0:
+            paged_kw["kv_host_tier_bytes"] = tier_bytes
+            paged_kw["conversation_pin_s"] = app.config.get_float(
+                "CONVERSATION_PIN_S", 600.0)
+            if app.config.get_bool("KV_REDIS_TIER", False):
+                from gofr_tpu.datasource.kvredis import RedisKVStore
+
+                paged_kw["kv_redis"] = RedisKVStore(
+                    app.config, app.logger,
+                    app.container.metrics_manager)
+                ttl = app.config.get_float("KV_REDIS_TTL_S", 0.0)
+                if ttl > 0:
+                    paged_kw["kv_redis_ttl_s"] = ttl
     # HBM capacity plan: clamp (MAX_BATCH, MAX_SEQ_LEN) to the device budget
     # before boot instead of discovering RESOURCE_EXHAUSTED mid-serve.
     # Auto-detected from the device (0 on CPU backends = no plan);
@@ -326,7 +353,10 @@ def build_app(config=None, engine=None) -> App:
     if engine is None:
         engine = build_engine(app)
     elif getattr(engine, "tokenizer", None) is None:
-        engine.tokenizer = ByteTokenizer()
+        vocab = getattr(getattr(engine, "cfg", None), "vocab_size", 0)
+        engine.tokenizer = (DebugTokenizer(vocab)
+                            if vocab > ByteTokenizer.vocab_size
+                            else ByteTokenizer())
     app.engine = engine
     # idempotent when build_engine already registered them (both are
     # name-keyed); covers the injected-engine path (tests) too
@@ -451,6 +481,12 @@ def build_app(config=None, engine=None) -> App:
         prefix = getattr(engine, "prefix", None)
         if prefix is not None:
             out["prefix_cache"] = prefix.stats()
+        kv_tier = getattr(engine, "kv_tier", None)
+        if kv_tier is not None:
+            tier = kv_tier.stats()
+            tier["spilled_pages"] = engine._kv_spilled
+            tier["restored_pages"] = engine._kv_restored
+            out["kv_tier"] = tier
         recorder = getattr(engine, "recorder", None)
         if recorder is not None:
             out["slo"] = recorder.slo_stats()

@@ -379,6 +379,19 @@ def build_app(**kw) -> App:
                     "data": [{"id": model_id, "object": "model",
                               "owned_by": "gofr_tpu"}]})
 
+    def _pin_conversation(conversation_id, prompt_toks, out_tokens):
+        """Resumable conversations: pin this turn's trunk pages (prompt +
+        response, full pages only) through the host KV tier so the
+        follow-up request restores them instead of re-prefilling. No-op
+        without KV_HOST_TIER_BYTES; never fails the response."""
+        pin = getattr(engine, "pin_conversation", None)
+        if not conversation_id or pin is None:
+            return
+        try:
+            pin(conversation_id, list(prompt_toks) + list(out_tokens))
+        except Exception:
+            pass
+
     def _completion(ctx, chat: bool):
         body = ctx.bind()
         if not isinstance(body, dict):
@@ -394,6 +407,10 @@ def build_app(**kw) -> App:
                 raise InvalidParam(["prompt"])
         (max_tokens, temperature, stop_strs, min_tokens, top_p,
          top_k) = _params(body)
+        conversation_id = body.get("conversation_id")
+        if conversation_id is not None and not isinstance(conversation_id,
+                                                          str):
+            raise InvalidParam(["conversation_id"])
         lp_n = _parse_logprobs(body, chat)
         if lp_n is not None and body.get("stream"):
             # scoring runs AFTER generation; attaching it to a stream would
@@ -453,9 +470,11 @@ def build_app(**kw) -> App:
                 # the last len(longest_stop)-1 chars until more text lands
                 hold = max((len(s) for s in stop_strs), default=0) - 1
                 acc, sent, stopped = "", 0, False
+                out_toks = []
                 floor_chars = None if min_tokens else 0
                 for token in request.stream():
                     count += 1
+                    out_toks.append(token)
                     acc += decoder.push(token)
                     if floor_chars is None:
                         if count < min_tokens:
@@ -498,6 +517,7 @@ def build_app(**kw) -> App:
                     stopped = cut >= 0
                     if end > sent:
                         yield _chunk(text=acc[sent:end])
+                _pin_conversation(conversation_id, prompt_toks, out_toks)
                 finish = "stop" if stopped else _finish_reason(count, max_tokens)
                 yield _chunk(finish=finish)
                 yield "[DONE]"
@@ -508,6 +528,7 @@ def build_app(**kw) -> App:
             tokens = request.result(timeout_s=ctx.remaining())
         except TimeoutError as exc:
             raise RequestTimeout() from exc
+        _pin_conversation(conversation_id, prompt_toks, tokens)
         text, finish = _apply_stops(tokenizer.decode(tokens), len(tokens),
                                     max_tokens, stop_strs,
                                     _floor_chars(tokens, min_tokens))

@@ -41,6 +41,69 @@ class ByteTokenizer:
         return ""
 
 
+class DebugTokenizer:
+    """Round-trip tokenizer for synthetic model vocabularies (the `debug`
+    preset's vocab_size=512).
+
+    ByteTokenizer silently DROPS ids >= 256 and random-weight byte
+    emissions form invalid UTF-8 that collapses to replacement chars — so
+    under the debug preset, 12 sampled tokens could decode to 3 visible
+    characters and anything measuring text length against token count
+    (min_tokens stop-string gating, SSE chunk accounting) tested nothing.
+    Here every non-special id decodes to EXACTLY ONE printable character:
+
+      * ids 0..255 ride the GPT-2 byte<->unicode table (bytes_to_unicode):
+        printable ASCII maps to itself, so ordinary prompt text encodes to
+        the same ids ByteTokenizer produces;
+      * PAD/BOS/EOS decode to "" (specials are invisible, as in real
+        vocabs);
+      * ids 259..vocab_size-1 map into the Unicode private use area
+        (U+E000 + id), distinct and reversible.
+
+    decode(encode(text)) == text for any text of mapped characters, and
+    encode(decode([id])) == [id] for every non-special id."""
+
+    PAD = 256
+    BOS = 257
+    EOS = 258
+
+    _PUA = 0xE000
+
+    def __init__(self, vocab_size: int = 512):
+        if vocab_size < 259:
+            raise ValueError("DebugTokenizer needs vocab_size >= 259")
+        self.vocab_size = vocab_size
+        b2u, u2b = _byte_maps()
+        self._id2ch = {i: b2u[i] for i in range(256)}
+        for i in range(259, vocab_size):
+            self._id2ch[i] = chr(self._PUA + i)
+        self._ch2id = {c: i for i, c in self._id2ch.items()}
+
+    def encode(self, text: str, bos: bool = True,
+               eos: bool = False) -> List[int]:
+        ids = []
+        for ch in text:
+            known = self._ch2id.get(ch)
+            if known is not None:
+                ids.append(known)
+            else:
+                # unmapped chars fall back to their UTF-8 bytes (byte ids
+                # round-trip through the table), same ids ByteTokenizer
+                # would produce
+                ids.extend(ch.encode("utf-8"))
+        if bos:
+            ids = [self.BOS] + ids
+        if eos:
+            ids = ids + [self.EOS]
+        return ids
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return "".join(self._id2ch.get(i, "") for i in ids)
+
+    def decode_token(self, token: int) -> str:
+        return self._id2ch.get(token, "")
+
+
 class StreamingDecoder:
     """Accumulates byte tokens and yields complete UTF-8 characters — what the
     SSE token stream sends so clients never see broken codepoints.

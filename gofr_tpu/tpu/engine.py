@@ -2272,6 +2272,17 @@ class LLMEngine:
             except Exception:  # noqa: BLE001 - overlap is optional
                 pass
 
+    def _fetch_host(self, *arrays) -> List[Any]:
+        """Blocking device->host fetch that still overlaps the transfers
+        with each other: start EVERY copy async first (the KV spill path
+        pulls k/v[/scale] page slices together), then materialize. The
+        np.asarray is the completion check, same contract as
+        _sync_oldest."""
+        import numpy as np
+
+        self._start_d2h(*arrays)
+        return [np.asarray(a) for a in arrays]
+
     def _dispatch_decode(self) -> None:
         # one decode program per allocated cache size: growth keeps the
         # allocation (and so the per-step scatter+read cost) tracking the

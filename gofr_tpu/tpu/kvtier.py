@@ -1,0 +1,394 @@
+"""Tiered KV page cache: host-RAM warm tier + optional Redis cold tier.
+
+The paged engine's `PrefixCache` shares KV pages only while they stay
+resident in the HBM-modeled page pool — LRU eviction hands the page id
+back to the allocator and the KV content is gone, so a multi-turn chat
+working set larger than the pool re-pays full prefill every turn. This
+module keeps evicted page CONTENT alive in cheaper memory:
+
+    HBM page pool  --spill on evict-->  HostKVTier (pinned numpy blobs)
+                                            |  write-behind on evict
+                                            v
+                                        RedisKVTier (base64+crc32 blobs)
+
+Keys are the PrefixCache's cumulative chain keys, so a page blob is
+addressed by the full token history it encodes. Every tier verifies the
+stored token content against the requested tokens on get — a hash
+collision or a corrupt blob degrades to a miss (recompute), never to
+serving another prompt's KV. That mirrors prefixcache.py's collision
+posture and is what makes restore safe to gate only on a bit-equivalence
+test rather than on trust in the hash.
+
+Threading: the engine loop thread calls put()/get() during admission and
+eviction; HTTP handler threads call pin() (conversation pinning) and
+stats(). A single lock covers the index; blob payloads are immutable
+numpy arrays once stored, so readers outside the lock are safe.
+
+The Redis tier rides the gated `datasource/kvredis.py` driver (or any
+object with its set/get/delete surface, e.g. the test fake). It is
+strictly best-effort: a down Redis raises ConnectionError inside the
+driver, which this module swallows and counts — serving never blocks on
+the cold tier.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import queue
+import threading
+import time
+import zlib
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+try:
+    # np.dtype("bfloat16") only resolves after ml_dtypes (a jax dep)
+    # registers it — without this, decode_blob would degrade EVERY bf16
+    # cold-tier blob to a miss
+    import ml_dtypes  # noqa: F401
+except ImportError:  # pragma: no cover - jax environments ship it
+    pass
+
+BLOB_VERSION = 1
+
+
+class PageBlob:
+    """One page's KV content on the host: the `[L, Hkv, dh, ps]` k/v
+    slices of the pool (plus int8 scale planes when the pool is q8),
+    alongside the exact tokens the page encodes for content
+    verification."""
+
+    __slots__ = ("tokens", "k", "v", "k_scale", "v_scale")
+
+    def __init__(self, tokens: Sequence[int], k: np.ndarray, v: np.ndarray,
+                 k_scale: Optional[np.ndarray] = None,
+                 v_scale: Optional[np.ndarray] = None):
+        self.tokens: Tuple[int, ...] = tuple(int(t) for t in tokens)
+        self.k = np.ascontiguousarray(k)
+        self.v = np.ascontiguousarray(v)
+        self.k_scale = (np.ascontiguousarray(k_scale)
+                        if k_scale is not None else None)
+        self.v_scale = (np.ascontiguousarray(v_scale)
+                        if v_scale is not None else None)
+
+    @property
+    def nbytes(self) -> int:
+        n = self.k.nbytes + self.v.nbytes
+        if self.k_scale is not None:
+            n += self.k_scale.nbytes
+        if self.v_scale is not None:
+            n += self.v_scale.nbytes
+        return n
+
+
+# -- wire format for the cold tier -------------------------------------------
+
+def _pack_array(arr: np.ndarray) -> Dict[str, Any]:
+    return {"dtype": str(arr.dtype), "shape": list(arr.shape),
+            "data": base64.b64encode(arr.tobytes()).decode("ascii")}
+
+
+def _unpack_array(spec: Dict[str, Any]) -> np.ndarray:
+    raw = base64.b64decode(spec["data"].encode("ascii"))
+    return np.frombuffer(raw, dtype=np.dtype(spec["dtype"])).reshape(
+        spec["shape"]).copy()
+
+
+def encode_blob(blob: PageBlob) -> str:
+    """Versioned, checksummed JSON envelope. Stored as a STRING because
+    the Redis datasource runs decode_responses=True (string wire) and the
+    in-repo fake stores str(value) — a str round-trips both."""
+    body: Dict[str, Any] = {
+        "v": BLOB_VERSION,
+        "tokens": list(blob.tokens),
+        "k": _pack_array(blob.k),
+        "val": _pack_array(blob.v),
+    }
+    if blob.k_scale is not None:
+        body["k_scale"] = _pack_array(blob.k_scale)
+    if blob.v_scale is not None:
+        body["v_scale"] = _pack_array(blob.v_scale)
+    payload = blob.k.tobytes() + blob.v.tobytes()
+    if blob.k_scale is not None:
+        payload += blob.k_scale.tobytes()
+    if blob.v_scale is not None:
+        payload += blob.v_scale.tobytes()
+    body["crc"] = zlib.crc32(payload) & 0xFFFFFFFF
+    return json.dumps(body)
+
+
+def decode_blob(raw: Any) -> Optional[PageBlob]:
+    """Envelope -> PageBlob; any structural problem, version skew, or
+    checksum mismatch returns None (degrade to miss, never wrong KV)."""
+    try:
+        if isinstance(raw, bytes):
+            raw = raw.decode("utf-8")
+        body = json.loads(raw)
+        if body.get("v") != BLOB_VERSION:
+            return None
+        k = _unpack_array(body["k"])
+        v = _unpack_array(body["val"])
+        k_scale = (_unpack_array(body["k_scale"])
+                   if "k_scale" in body else None)
+        v_scale = (_unpack_array(body["v_scale"])
+                   if "v_scale" in body else None)
+        payload = k.tobytes() + v.tobytes()
+        if k_scale is not None:
+            payload += k_scale.tobytes()
+        if v_scale is not None:
+            payload += v_scale.tobytes()
+        if (zlib.crc32(payload) & 0xFFFFFFFF) != body.get("crc"):
+            return None
+        return PageBlob(body["tokens"], k, v, k_scale, v_scale)
+    except Exception:  # noqa: BLE001 - corrupt blob IS the expected failure
+        return None
+
+
+class RedisKVTier:
+    """Cold tier over the gated Redis datasource (or any set/get/delete
+    twin). Write-behind by default: puts enqueue onto a bounded queue
+    drained by a daemon worker, so host-tier eviction never blocks on the
+    network; a full queue drops the blob (it is a CACHE — the only cost
+    is a future recompute). `write_behind=False` runs puts inline for
+    deterministic tests."""
+
+    KEY_PREFIX = "gofr:kvpage:"
+
+    def __init__(self, store: Any, write_behind: bool = True,
+                 ttl_s: Optional[float] = None, queue_depth: int = 64):
+        self.store = store
+        self.ttl_s = ttl_s
+        self.hits = 0
+        self.misses = 0
+        self.stored = 0
+        self.corrupt = 0
+        self.errors = 0
+        self.dropped = 0
+        self._lock = threading.Lock()
+        self._q: Optional["queue.Queue"] = None
+        if write_behind:
+            self._q = queue.Queue(maxsize=queue_depth)
+            worker = threading.Thread(target=self._drain,
+                                      name="kvtier-redis-writer", daemon=True)
+            worker.start()
+
+    def _key(self, key: int) -> str:
+        return f"{self.KEY_PREFIX}{key:#x}"
+
+    def _set(self, key: int, blob: PageBlob) -> None:
+        try:
+            self.store.set(self._key(key), encode_blob(blob),
+                           ttl_s=self.ttl_s)
+            with self._lock:
+                self.stored += 1
+        except Exception:  # noqa: BLE001 - cold tier is best-effort
+            with self._lock:
+                self.errors += 1
+
+    def _drain(self) -> None:
+        while True:
+            key, blob = self._q.get()
+            try:
+                self._set(key, blob)
+            finally:
+                self._q.task_done()
+
+    def put(self, key: int, blob: PageBlob) -> None:
+        if self._q is None:
+            self._set(key, blob)
+            return
+        try:
+            self._q.put_nowait((key, blob))
+        except queue.Full:
+            with self._lock:
+                self.dropped += 1
+
+    def get(self, key: int, tokens: Sequence[int]) -> Optional[PageBlob]:
+        try:
+            raw = self.store.get(self._key(key))
+        except Exception:  # noqa: BLE001
+            with self._lock:
+                self.errors += 1
+            return None
+        if raw is None:
+            with self._lock:
+                self.misses += 1
+            return None
+        blob = decode_blob(raw)
+        if blob is None or blob.tokens != tuple(int(t) for t in tokens):
+            # corrupt or collided: purge so the next lookup is a clean miss
+            with self._lock:
+                self.corrupt += 1
+                self.misses += 1
+            try:
+                self.store.delete(self._key(key))
+            except Exception:  # noqa: BLE001
+                pass
+            return None
+        with self._lock:
+            self.hits += 1
+        return blob
+
+    def flush(self, timeout_s: float = 5.0) -> None:
+        """Block until the write-behind queue drains (tests/shutdown)."""
+        if self._q is None:
+            return
+        deadline = time.monotonic() + timeout_s
+        while not self._q.empty() and time.monotonic() < deadline:
+            time.sleep(0.005)
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"hits": self.hits, "misses": self.misses,
+                    "stored": self.stored, "corrupt": self.corrupt,
+                    "errors": self.errors, "dropped": self.dropped}
+
+
+class HostKVTier:
+    """Bounded host-RAM LRU over PageBlobs, keyed by cumulative prefix
+    keys. Spill target for PrefixCache eviction and restore source for
+    admission; optionally backed by a RedisKVTier cold tier (write-behind
+    on eviction, promote-on-hit)."""
+
+    def __init__(self, capacity_bytes: int, page_size: int,
+                 cold: Optional[RedisKVTier] = None):
+        self.capacity_bytes = int(capacity_bytes)
+        self.page_size = page_size
+        self.cold = cold
+        self._blobs: "OrderedDict[int, PageBlob]" = OrderedDict()
+        self._pins: Dict[int, float] = {}          # key -> pin deadline
+        self._lock = threading.Lock()
+        self.used_bytes = 0
+        self.hits = 0
+        self.misses = 0
+        self.stored = 0
+        self.evicted = 0
+        self.corrupt = 0
+        self.rejected = 0
+
+    # -- internals (caller holds the lock) -----------------------------------
+    def _pinned(self, key: int, now: float) -> bool:
+        deadline = self._pins.get(key)
+        if deadline is None:
+            return False
+        if deadline <= now:
+            del self._pins[key]
+            return False
+        return True
+
+    def _evict_to_fit(self) -> None:
+        """LRU-evict until under capacity, skipping unexpired pins. When
+        everything left is pinned the tier runs temporarily over budget —
+        pins are TTL-bounded, so the overshoot is too; starving the spill
+        path instead would silently turn pinning into data loss."""
+        now = time.monotonic()
+        if self.used_bytes <= self.capacity_bytes:
+            return
+        for key in list(self._blobs):
+            if self.used_bytes <= self.capacity_bytes:
+                break
+            if self._pinned(key, now):
+                continue
+            blob = self._blobs.pop(key)
+            self.used_bytes -= blob.nbytes
+            self.evicted += 1
+            if self.cold is not None:
+                self.cold.put(key, blob)
+
+    # -- the tier protocol ---------------------------------------------------
+    def put(self, key: int, blob: PageBlob) -> bool:
+        """Admit a spilled page. Returns False when the blob alone exceeds
+        capacity (it would evict the whole tier for one entry)."""
+        if blob.nbytes > self.capacity_bytes:
+            with self._lock:
+                self.rejected += 1
+            return False
+        with self._lock:
+            old = self._blobs.pop(key, None)
+            if old is not None:
+                self.used_bytes -= old.nbytes
+            self._blobs[key] = blob
+            self.used_bytes += blob.nbytes
+            self.stored += 1
+            self._evict_to_fit()
+        return True
+
+    def get(self, key: int, tokens: Sequence[int]) -> Optional[PageBlob]:
+        """Content-verified lookup; falls through to the cold tier on miss
+        and promotes a cold hit back into host RAM."""
+        want = tuple(int(t) for t in tokens)
+        with self._lock:
+            blob = self._blobs.get(key)
+            if blob is not None:
+                if blob.tokens != want:
+                    # collision/corruption: drop so it cannot hit again
+                    self._blobs.pop(key)
+                    self.used_bytes -= blob.nbytes
+                    self.corrupt += 1
+                    self.misses += 1
+                    return None
+                self._blobs.move_to_end(key)
+                self.hits += 1
+                return blob
+            self.misses += 1
+        if self.cold is None:
+            return None
+        cold_blob = self.cold.get(key, want)
+        if cold_blob is not None:
+            self.put(key, cold_blob)   # promote: next turn hits warm
+        return cold_blob
+
+    def contains(self, key: int, tokens: Sequence[int]) -> bool:
+        """Non-mutating peek (no LRU touch, no counters, no cold probe)."""
+        want = tuple(int(t) for t in tokens)
+        with self._lock:
+            blob = self._blobs.get(key)
+            return blob is not None and blob.tokens == want
+
+    def pin(self, keys: Sequence[int], ttl_s: float) -> int:
+        """Protect the given chain keys from warm-tier LRU eviction for
+        ttl_s seconds (conversation pinning: a resumable conversation's
+        trunk must survive churn between turns). Pins are residency-
+        INDEPENDENT: a trunk page still live in HBM spills here later,
+        and the pin must already cover it when the blob arrives."""
+        now = time.monotonic()
+        deadline = now + ttl_s
+        with self._lock:
+            # opportunistic prune so the pin set tracks live conversations
+            for stale in [k for k, d in self._pins.items() if d <= now]:
+                del self._pins[stale]
+            for key in keys:
+                self._pins[key] = max(self._pins.get(key, 0.0), deadline)
+        return len(keys)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._blobs.clear()
+            self._pins.clear()
+            self.used_bytes = 0
+
+    def keys(self) -> List[int]:
+        with self._lock:
+            return list(self._blobs)
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            now = time.monotonic()
+            out = {
+                "capacity_bytes": self.capacity_bytes,
+                "used_bytes": self.used_bytes,
+                "pages": len(self._blobs),
+                "hits": self.hits,
+                "misses": self.misses,
+                "stored": self.stored,
+                "evicted": self.evicted,
+                "corrupt": self.corrupt,
+                "rejected": self.rejected,
+                "pinned": sum(1 for k, d in self._pins.items() if d > now),
+            }
+        if self.cold is not None:
+            out["redis"] = self.cold.stats()
+        return out

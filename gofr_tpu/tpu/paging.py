@@ -92,7 +92,9 @@ class PagedLLMEngine(LLMEngine):
 
     def __init__(self, params, cfg: LlamaConfig, *, page_size: int = 128,
                  n_pages: Optional[int] = None, prefix_cache: bool = False,
-                 **kw):
+                 kv_host_tier_bytes: int = 0, kv_redis=None,
+                 kv_redis_ttl_s: Optional[float] = None,
+                 conversation_pin_s: float = 600.0, **kw):
         # chunked prefill runs against bucket-sized per-job TEMPS and
         # scatters into pages once at the final chunk (_chunk_fn_paged);
         # speculative verify gathers pages into contiguous rows per layer
@@ -106,6 +108,30 @@ class PagedLLMEngine(LLMEngine):
         # see tpu/prefixcache.py. int8 pools share scales alongside values
         # (the prefix program's gathered read dequantizes per page)
         self._prefix_enabled = bool(prefix_cache)
+        # tiered KV (tpu/kvtier.py): prefix pages evicted from the pool
+        # spill to a host-RAM LRU (optionally write-behind to Redis) and
+        # restore by H2D copy on the next prefix hit instead of
+        # re-prefilling. Built OUTSIDE _init_device_state on purpose: the
+        # blobs are content-keyed host copies of deterministic KV, so they
+        # stay valid across device resets (the pool and PrefixCache
+        # rebuild; the tiers do not)
+        self.kv_tier = None
+        self.conversation_pin_s = float(conversation_pin_s)
+        self._kv_spilled = 0    # lifetime page counts for /debug/engine
+        self._kv_restored = 0
+        if kv_host_tier_bytes:
+            if not self._prefix_enabled:
+                raise ValueError(
+                    "kv_host_tier_bytes requires prefix_cache=True: tier "
+                    "blobs are addressed by the prefix cache's chain keys")
+            from .kvtier import HostKVTier, RedisKVTier
+
+            cold = None
+            if kv_redis is not None:
+                cold = (kv_redis if isinstance(kv_redis, RedisKVTier)
+                        else RedisKVTier(kv_redis, ttl_s=kv_redis_ttl_s))
+            self.kv_tier = HostKVTier(kv_host_tier_bytes, page_size,
+                                      cold=cold)
         # set pre-super: _init_device_state runs inside super().__init__
         super().__init__(params, cfg, **kw)
 
@@ -246,6 +272,14 @@ class PagedLLMEngine(LLMEngine):
         if self.prefix is not None:
             if request.id not in self._prefix_hits:
                 hit = self.prefix.match(request.resume_tokens)
+                if self.kv_tier is not None:
+                    # extend the HBM hit from the host/Redis tiers: a
+                    # restored page costs one H2D page copy instead of a
+                    # page of prefill compute. Nested inside page_alloc —
+                    # seg() subtracts child time from the parent, so the
+                    # restore cost is attributable on its own
+                    with self.steps.seg("kv_restore"):
+                        hit = self._restore_from_tier(request, hit)
                 if hit and self._tail_routes_to_chunk(request, hit):
                     # the tail would still chunk: drop the hit NOW, before
                     # the reservation is sized — deciding later would leave
@@ -263,8 +297,10 @@ class PagedLLMEngine(LLMEngine):
         if pages is None and self.prefix is not None:
             # idle cache pages are reclaimable capacity: evict LRU entries
             # into the free list and retry before parking the request
+            # (eviction spills the pages' KV to the host tier first when
+            # tiering is on — see _evict_prefix_pages)
             self.allocator.release(
-                self.prefix.evict(need - self.allocator.free_pages))
+                self._evict_prefix_pages(need - self.allocator.free_pages))
             pages = self.allocator.alloc(need)
         if pages is None:
             self._obs.counter("app_tpu_page_waits_total")
@@ -336,6 +372,211 @@ class PagedLLMEngine(LLMEngine):
 
         self._run_off_loop(flush)
 
+    # -- tiered KV: spill on evict, restore on hit ----------------------------
+    def _evict_prefix_pages(self, n: int) -> List[int]:
+        """prefix.evict + KV spill: fetch the evicted pages' KV to the
+        host (the async-D2H machinery) and hand the blobs to the tier
+        BEFORE the page ids return to the allocator — once reallocated,
+        the pool slots are overwritten and the content is gone."""
+        entries = self.prefix.evict_entries(n)
+        if entries and self.kv_tier is not None:
+            try:
+                self._spill_pages(entries)
+            except Exception:  # noqa: BLE001 - spill is an optimization:
+                pass           # losing it degrades to recompute, never worse
+
+        return [page_id for _, page_id, _ in entries]
+
+    def _spill_pages(self, entries) -> None:
+        from .kvtier import PageBlob
+
+        ids = np.asarray([pid for _, pid, _ in entries], dtype=np.int32)
+        # batched gather: one [L, n, Hkv, dh, ps] slice per pool — a NEW
+        # buffer, so later donation of the pool cannot invalidate it; all
+        # D2H copies start async before the first blocks
+        pulls = [self.k_cache[:, ids], self.v_cache[:, ids]]
+        if self._q8:
+            pulls += [self.k_scale[:, ids], self.v_scale[:, ids]]
+        host = self._fetch_host(*pulls)
+        k, v = host[0], host[1]
+        ks, vs = (host[2], host[3]) if self._q8 else (None, None)
+        stored = 0
+        for i, (key, _, toks) in enumerate(entries):
+            blob = PageBlob(toks, k[:, i], v[:, i],
+                            None if ks is None else ks[:, i],
+                            None if vs is None else vs[:, i])
+            if self.kv_tier.put(key, blob):
+                stored += 1
+        if stored:
+            self._kv_spilled += stored
+            self._obs.counter("app_tpu_kv_tier_spilled_total", stored)
+
+    def _restore_from_tier(self, request: GenerationRequest,
+                           hit: List[int]) -> List[int]:
+        """Continue the prefix walk past the HBM hit through the host (and
+        Redis) tiers: consecutive content-verified tier hits allocate
+        fresh pages and restore by H2D scatter, so only the genuinely
+        un-cached tail re-prefills. Returns the extended hit list with the
+        restored pages ref'd exactly like matched ones (insert grants the
+        owner ref; _finish_slot/_abort_admission release it)."""
+        tokens = request.resume_tokens
+        ps = self.page_size
+        matchable = max(0, (len(tokens) - 1) // ps)
+        start = len(hit)
+        if start >= matchable:
+            return hit
+        tier = self.kv_tier
+        L, _, Hkv, dh, _ = self.k_cache.shape
+        pool_dt = np.dtype(self.k_cache.dtype)
+        corrupt0 = tier.corrupt + (tier.cold.corrupt if tier.cold else 0)
+        keys = self.prefix.keys_for(tokens, matchable)
+        blobs = []
+        for i in range(start, matchable):
+            blob = tier.get(keys[i], tokens[i * ps:(i + 1) * ps])
+            if blob is None:
+                break
+            # config-skew guard (a Redis blob can outlive the process that
+            # wrote it): a blob whose shape/dtype does not match THIS pool
+            # is a miss, not a crash
+            if (blob.k.shape != (L, Hkv, dh, ps)
+                    or blob.k.dtype != pool_dt
+                    or (self._q8 and blob.k_scale is None)):
+                break
+            blobs.append(blob)
+        corrupt = (tier.corrupt
+                   + (tier.cold.corrupt if tier.cold else 0)) - corrupt0
+        if corrupt:
+            self._obs.counter("app_tpu_kv_tier_corrupt_total", corrupt)
+        if blobs:
+            self._obs.counter("app_tpu_kv_tier_hits_total", len(blobs))
+        missed = matchable - start - len(blobs)
+        if missed:
+            self._obs.counter("app_tpu_kv_tier_misses_total", missed)
+        if not blobs:
+            return hit
+        need = len(blobs)
+        pages = self.allocator.alloc(need)
+        if pages is None:
+            self.allocator.release(
+                self._evict_prefix_pages(need - self.allocator.free_pages))
+            pages = self.allocator.alloc(need)
+        if pages is None:
+            # pool too tight to host the restored pages: recompute the
+            # tail instead of deadlocking admission on its own cache
+            return hit
+        try:
+            self._h2d_restore(pages, blobs)
+        except Exception:  # noqa: BLE001 - restore is optional: fall back
+            self.allocator.release(pages)   # to recompute; a real device
+            return hit                      # loss resurfaces at dispatch
+        # register the restored pages under their chain keys: insert sees
+        # the first `start` keys already cached (skipped) and grants the
+        # owner ref on the new ones — the SAME release discipline as
+        # freshly-prefilled pages, so finish/abort need no special case
+        self.prefix.insert(list(tokens[:(start + need) * ps + 1]),
+                           list(hit) + pages)
+        self._kv_restored += need
+        self._obs.counter("app_tpu_kv_tier_restored_total", need)
+        if self.recorder is not None:
+            self.recorder.record_event(request.id, "kv_restore",
+                                       pages=need)
+        return list(hit) + pages
+
+    def _restore_fn(self):
+        def restore(k_pool, v_pool, pages, new_k, new_v):
+            """Scatter n restored pages into the pool. Rows padding n up
+            to the compiled pow2 width carry page id 0 — the garbage page
+            — with zero payloads, so padding (and its duplicate indices)
+            can never touch a live page."""
+            k_pool, v_pool = _pin_standard_layout(k_pool, v_pool)
+            k_pool = k_pool.at[:, pages].set(new_k)
+            v_pool = v_pool.at[:, pages].set(new_v)
+            k_pool, v_pool = _pin_standard_layout(k_pool, v_pool)
+            return k_pool, v_pool
+
+        return restore
+
+    def _restore_fn_q8(self):
+        def restore(k_pool, v_pool, k_scale, v_scale, pages, new_k, new_v,
+                    new_ks, new_vs):
+            k_pool, v_pool = _pin_standard_layout(k_pool, v_pool)
+            k_pool = k_pool.at[:, pages].set(new_k)
+            v_pool = v_pool.at[:, pages].set(new_v)
+            k_scale = k_scale.at[:, pages].set(new_ks)
+            v_scale = v_scale.at[:, pages].set(new_vs)
+            k_pool, v_pool = _pin_standard_layout(k_pool, v_pool)
+            return k_pool, v_pool, k_scale, v_scale
+
+        return restore
+
+    def _restore_program(self, n: int):
+        jnp = self._jnp
+        L, _, Hkv, dh, ps = self.k_cache.shape
+        kv = (jnp.zeros((L, n, Hkv, dh, ps), dtype=self.k_cache.dtype),
+              jnp.zeros((L, n, Hkv, dh, ps), dtype=self.k_cache.dtype))
+        ids = jnp.zeros((n,), dtype=jnp.int32)
+        if self._q8:
+            scales = (jnp.zeros((L, n, Hkv, ps), dtype=jnp.float32),
+                      jnp.zeros((L, n, Hkv, ps), dtype=jnp.float32))
+            args = (self.k_cache, self.v_cache, self.k_scale, self.v_scale,
+                    ids, *kv, *scales)
+            return self.executor.compile(
+                f"llama-paged-restore-q8-N{n}{self._id_tag}",
+                self._restore_fn_q8(), args, donate_argnums=(0, 1, 2, 3))
+        args = (self.k_cache, self.v_cache, ids, *kv)
+        return self.executor.compile(
+            f"llama-paged-restore-N{n}{self._id_tag}",
+            self._restore_fn(), args, donate_argnums=(0, 1))
+
+    def _h2d_restore(self, pages: List[int], blobs) -> None:
+        jnp = self._jnp
+        L, _, Hkv, dh, ps = self.k_cache.shape
+        n = _pow2_at_least(len(pages))
+        ids = np.zeros((n,), dtype=np.int32)   # pads -> garbage page 0
+        ids[:len(pages)] = pages
+        new_k = np.zeros((L, n, Hkv, dh, ps),
+                         dtype=np.dtype(self.k_cache.dtype))
+        new_v = np.zeros_like(new_k)
+        for i, blob in enumerate(blobs):
+            new_k[:, i] = blob.k
+            new_v[:, i] = blob.v
+        program = self._restore_program(n)
+        if self._q8:
+            new_ks = np.zeros((L, n, Hkv, ps), dtype=np.float32)
+            new_vs = np.zeros_like(new_ks)
+            for i, blob in enumerate(blobs):
+                new_ks[:, i] = blob.k_scale
+                new_vs[:, i] = blob.v_scale
+            (self.k_cache, self.v_cache, self.k_scale,
+             self.v_scale) = program(
+                self.k_cache, self.v_cache, self.k_scale, self.v_scale,
+                jnp.asarray(ids), jnp.asarray(new_k), jnp.asarray(new_v),
+                jnp.asarray(new_ks), jnp.asarray(new_vs))
+        else:
+            self.k_cache, self.v_cache = program(
+                self.k_cache, self.v_cache, jnp.asarray(ids),
+                jnp.asarray(new_k), jnp.asarray(new_v))
+
+    def pin_conversation(self, conversation_id: str,
+                         tokens: Sequence[int]) -> int:
+        """Pin a conversation trunk's chain keys through the HOST tier for
+        conversation_pin_s seconds (callable from handler threads: key
+        derivation is pure, the tier locks internally). Pins protect
+        host-tier residency ONLY — HBM eviction stays unconditional,
+        because a pool that cannot evict cannot admit (an HBM pin could
+        deadlock admission); the spill path preserves the pinned trunk on
+        its way down anyway. conversation_id is observability context."""
+        if self.kv_tier is None or self.prefix is None:
+            return 0
+        n_full = len(tokens) // self.page_size
+        if n_full <= 0:
+            return 0
+        keys = self.prefix.keys_for(tokens, n_full)
+        pinned = self.kv_tier.pin(keys, self.conversation_pin_s)
+        if pinned:
+            self._obs.counter("app_tpu_kv_tier_pinned_total", pinned)
+        return pinned
+
     # -- programs -------------------------------------------------------------
     def warmup(self, grow: bool = True, k_variants: bool = False) -> None:
         with self._state_lock:
@@ -373,6 +614,13 @@ class PagedLLMEngine(LLMEngine):
                     self._prefix_program(
                         tail_b, 1,
                         _pow2_at_least(self.allocator.pages_for(bucket)))
+            if self.kv_tier is not None:
+                # restore widths are organic (however many consecutive
+                # tier hits the walk finds, pow2-padded); warm the small
+                # ones so a conversation's first resume doesn't compile
+                # on the loop thread
+                for n in (1, 2):
+                    self._restore_program(n)
             # warm the table widths the first admissions will actually hit:
             # dispatch uses pow2(widest_pages + 1), so NP=1 never occurs
             warm_widths = set()

@@ -84,6 +84,12 @@ class PrefixCache:
             keys.append(h)
         return keys
 
+    def keys_for(self, tokens: Sequence[int], n_pages: int) -> List[int]:
+        """Public chain keys: the host/Redis KV tiers address spilled page
+        blobs by the SAME cumulative keys, so a tier lookup for page i of
+        a prompt is exactly keys_for(prompt, i+1)[-1]."""
+        return self._keys_for(tokens, n_pages)
+
     # -- the serving protocol ------------------------------------------------
     def match(self, tokens: Sequence[int]) -> List[int]:
         """Longest run of cached full pages from page 0, with at least one
@@ -149,7 +155,15 @@ class PrefixCache:
         children (leaf-first: a chain evicts tail-inward, never stranding
         a descendant); returns the page ids for the allocator's free
         list."""
-        freed: List[int] = []
+        return [page_id for _, page_id, _ in self.evict_entries(n)]
+
+    def evict_entries(self, n: int) -> List[Tuple[int, int, tuple]]:
+        """evict() with full entry detail: (chain_key, page_id, tokens)
+        per reclaimed page. The tiered KV cache needs all three to spill
+        the page's content to host RAM under its content-verified key
+        BEFORE the page id returns to the allocator and the pool slot is
+        overwritten."""
+        freed: List[Tuple[int, int, tuple]] = []
         if n <= 0:
             return freed
         progress = True
@@ -158,7 +172,7 @@ class PrefixCache:
             for key in list(self._entries):
                 if len(freed) >= n:
                     break
-                page_id, _ = self._entries[key]
+                page_id, content = self._entries[key]
                 if (self._refs.get(page_id, 0) != 0
                         or self._nchildren.get(key, 0) != 0):
                     continue
@@ -169,7 +183,7 @@ class PrefixCache:
                 del self._entries[key]
                 del self._key_of_page[page_id]
                 del self._refs[page_id]
-                freed.append(page_id)
+                freed.append((key, page_id, content))
                 self.evicted_pages += 1
                 progress = True
         return freed

@@ -21,7 +21,15 @@ explicit ``other`` residual means nothing can hide):
                    on the multi-controller plane
   ``page_alloc``   paged engines: page reservation / prefix-cache match /
                    eviction inside admission readiness (includes the
-                   page-wait path — an exhausted pool shows up here)
+                   page-wait path — an exhausted pool shows up here).
+                   KV spill to the host tier (D2H fetch of evicted pages)
+                   also lands here: it happens inside eviction
+  ``kv_restore``   paged engines with the tiered KV cache: host/Redis
+                   tier lookup plus the H2D scatter that rebuilds evicted
+                   prefix pages in the pool at admission, charged
+                   separately from ``page_alloc`` (nested segments
+                   subtract child time) so "restore is slower than
+                   recompute" is attributable from the ledger alone
   ``host_prep``    batch array prep: padding, lengths, sampling controls,
                    block tables
   ``compile``      executor cache-miss compiles, re-attributed out of
@@ -77,8 +85,8 @@ from typing import Any, Dict, List, Optional
 
 from .obs import MetricsHook
 
-SEGMENTS = ("admission", "page_alloc", "host_prep", "compile", "cache_grow",
-            "dispatch", "device_sync", "demux", "emit", "other")
+SEGMENTS = ("admission", "page_alloc", "kv_restore", "host_prep", "compile",
+            "cache_grow", "dispatch", "device_sync", "demux", "emit", "other")
 
 # step phases, by what the iteration synced (one sync per iteration) or,
 # sync-less, what it dispatched

@@ -319,10 +319,36 @@ def register_utilization_metrics(metrics) -> None:
          "HBM bytes per device (kind=in_use|limit)"),
         ("app_tpu_kv_pool_pages",
          "KV page-pool occupancy (kind=used|free)"),
+        ("app_tpu_kv_tier_bytes",
+         "host KV tier occupancy in bytes (kind=used|capacity)"),
+        ("app_tpu_kv_tier_pages",
+         "page blobs resident in the host KV tier"),
     ):
         try:
             if metrics.get(name) is None:
                 metrics.new_gauge(name, desc)
+        except Exception:  # noqa: BLE001 - already registered
+            pass
+    for name, desc in (
+        ("app_tpu_kv_tier_spilled_total",
+         "KV pages spilled from the pool to the host tier on eviction"),
+        ("app_tpu_kv_tier_restored_total",
+         "KV pages restored into the pool from the tiers by H2D copy"),
+        ("app_tpu_kv_tier_hits_total",
+         "tier lookups during the admission prefix walk that found a "
+         "verified page blob"),
+        ("app_tpu_kv_tier_misses_total",
+         "prefix pages past the HBM hit the tiers could not supply "
+         "(re-prefilled instead)"),
+        ("app_tpu_kv_tier_corrupt_total",
+         "tier blobs dropped on checksum/content verification failure "
+         "(degraded to a miss)"),
+        ("app_tpu_kv_tier_pinned_total",
+         "conversation-trunk chain keys pinned in the host tier"),
+    ):
+        try:
+            if metrics.get(name) is None:
+                metrics.new_counter(name, desc)
         except Exception:  # noqa: BLE001 - already registered
             pass
 
@@ -378,6 +404,14 @@ class MemorySampler:
                             kind="used")
             self._obs.gauge("app_tpu_kv_pool_pages", allocator.free_pages,
                             kind="free")
+        kv_tier = getattr(self.engine, "kv_tier", None)
+        if kv_tier is not None:
+            tier_stats = kv_tier.stats()
+            self._obs.gauge("app_tpu_kv_tier_bytes",
+                            tier_stats["used_bytes"], kind="used")
+            self._obs.gauge("app_tpu_kv_tier_bytes",
+                            tier_stats["capacity_bytes"], kind="capacity")
+            self._obs.gauge("app_tpu_kv_tier_pages", tier_stats["pages"])
 
     def _loop(self) -> None:
         while not self._stop.is_set():
@@ -457,6 +491,15 @@ def engine_snapshot(engine, tpu=None) -> Dict[str, Any]:
         if prefix is not None:
             try:
                 out["page_pool"]["prefix_cache"] = prefix.stats()
+            except Exception:  # noqa: BLE001
+                pass
+        kv_tier = getattr(engine, "kv_tier", None)
+        if kv_tier is not None:
+            try:
+                tier = kv_tier.stats()
+                tier["spilled_pages"] = getattr(engine, "_kv_spilled", 0)
+                tier["restored_pages"] = getattr(engine, "_kv_restored", 0)
+                out["page_pool"]["kv_tier"] = tier
             except Exception:  # noqa: BLE001
                 pass
 

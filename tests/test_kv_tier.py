@@ -1,0 +1,319 @@
+"""Tiered KV cache (tpu/kvtier.py + the paging integration).
+
+Unit lane (`-m tiercache`, no engine boot): blob wire format, host-tier
+capacity/LRU/pin semantics, corrupt-degrades-to-miss, Redis round-trip
+against the in-repo fake driver. Engine lane: the tentpole's correctness
+gate — tokens decoded from RESTORED pages are bit-equal to recompute,
+for both the bf16 and the int8 page pools.
+"""
+
+import dataclasses
+import sys
+import time
+import types
+from typing import Any, Dict
+
+import numpy as np
+import pytest
+
+from gofr_tpu.logging import MockLogger
+from gofr_tpu.tpu.kvtier import (HostKVTier, PageBlob, RedisKVTier,
+                                 decode_blob, encode_blob)
+
+PS = 8
+
+
+def _blob(tag: int, tokens=None, nbytes: int = 1024) -> PageBlob:
+    """A distinguishable blob: payload derived from `tag`, ~nbytes big."""
+    n = max(1, nbytes // 16)      # k + v at (2, n) float32 ~= nbytes total
+    k = np.full((2, n), tag, dtype=np.float32)
+    v = np.full((2, n), -tag, dtype=np.float32)
+    return PageBlob(tokens if tokens is not None
+                    else [tag + i for i in range(PS)], k, v)
+
+
+# -- wire format --------------------------------------------------------------
+@pytest.mark.tiercache
+def test_blob_encode_decode_roundtrip():
+    blob = PageBlob([1, 2, 3], np.arange(12, dtype=np.float32).reshape(3, 4),
+                    np.arange(12, 24, dtype=np.float32).reshape(3, 4),
+                    k_scale=np.ones((3,), dtype=np.float32),
+                    v_scale=np.full((3,), 2.0, dtype=np.float32))
+    out = decode_blob(encode_blob(blob))
+    assert out is not None
+    assert out.tokens == (1, 2, 3)
+    np.testing.assert_array_equal(out.k, blob.k)
+    np.testing.assert_array_equal(out.v, blob.v)
+    np.testing.assert_array_equal(out.k_scale, blob.k_scale)
+    np.testing.assert_array_equal(out.v_scale, blob.v_scale)
+
+
+@pytest.mark.tiercache
+def test_blob_decode_rejects_corruption():
+    import json
+
+    raw = encode_blob(_blob(5))
+    assert decode_blob(raw) is not None
+    # flipped payload byte -> crc mismatch -> miss
+    body = json.loads(raw)
+    data = body["k"]["data"]
+    body["k"]["data"] = data[:-4] + ("AAAA" if data[-4:] != "AAAA"
+                                     else "BBBB")
+    assert decode_blob(json.dumps(body)) is None
+    # version skew -> miss
+    body = json.loads(raw)
+    body["v"] = 99
+    assert decode_blob(json.dumps(body)) is None
+    # structural garbage -> miss, never a raise
+    assert decode_blob("{not json") is None
+    assert decode_blob(None) is None
+
+
+# -- host tier ----------------------------------------------------------------
+@pytest.mark.tiercache
+def test_host_tier_capacity_evicts_lru_order():
+    tier = HostKVTier(capacity_bytes=3 * 1024 + 512, page_size=PS)
+    for key in (1, 2, 3):
+        assert tier.put(key, _blob(key, nbytes=1024))
+    # touch key 1 so key 2 is now the LRU victim
+    assert tier.get(1, _blob(1).tokens) is not None
+    tier.put(4, _blob(4, nbytes=1024))
+    assert tier.keys() == [3, 1, 4]
+    assert tier.get(2, _blob(2).tokens) is None
+    st = tier.stats()
+    assert st["evicted"] == 1 and st["pages"] == 3
+    assert st["used_bytes"] <= st["capacity_bytes"]
+
+
+@pytest.mark.tiercache
+def test_host_tier_rejects_oversized_blob():
+    tier = HostKVTier(capacity_bytes=512, page_size=PS)
+    assert not tier.put(1, _blob(1, nbytes=4096))
+    assert tier.stats()["rejected"] == 1
+    assert tier.stats()["pages"] == 0
+
+
+@pytest.mark.tiercache
+def test_host_tier_collision_degrades_to_miss():
+    """A key whose stored tokens differ from the requested ones (hash
+    collision shape) must MISS and purge — never return the other
+    prompt's KV."""
+    tier = HostKVTier(capacity_bytes=1 << 20, page_size=PS)
+    tier.put(7, _blob(7, tokens=[1, 2, 3]))
+    assert tier.get(7, [9, 9, 9]) is None
+    assert tier.stats()["corrupt"] == 1
+    # the entry is gone: even the RIGHT tokens miss now
+    assert tier.get(7, [1, 2, 3]) is None
+
+
+@pytest.mark.tiercache
+def test_host_tier_pin_protects_then_expires():
+    tier = HostKVTier(capacity_bytes=2 * 1024 + 512, page_size=PS)
+    tier.put(1, _blob(1, nbytes=1024))
+    tier.put(2, _blob(2, nbytes=1024))
+    tier.pin([1], ttl_s=60.0)
+    # over capacity: the UNPINNED key 2 evicts even though 1 is older
+    tier.put(3, _blob(3, nbytes=1024))
+    assert tier.contains(1, _blob(1).tokens)
+    assert not tier.contains(2, _blob(2).tokens)
+    assert tier.stats()["pinned"] == 1
+    # pins are residency-independent: pinning an absent key is recorded
+    # so a later spill of that trunk arrives already protected
+    tier.pin([99], ttl_s=60.0)
+    assert tier.stats()["pinned"] == 2
+    # expired pins stop protecting (fresh tier: pins only EXTEND, so an
+    # active long pin cannot be shortened — concurrent pinners compose)
+    tier2 = HostKVTier(capacity_bytes=1024 + 256, page_size=PS)
+    tier2.put(1, _blob(1, nbytes=1024))
+    tier2.pin([1], ttl_s=0.02)
+    time.sleep(0.05)
+    tier2.put(4, _blob(4, nbytes=1024))
+    assert not tier2.contains(1, _blob(1).tokens)
+
+
+@pytest.mark.tiercache
+def test_host_tier_all_pinned_overshoots_instead_of_dropping():
+    tier = HostKVTier(capacity_bytes=1024 + 256, page_size=PS)
+    tier.put(1, _blob(1, nbytes=1024))
+    tier.pin([1, 2], ttl_s=60.0)
+    tier.put(2, _blob(2, nbytes=1024))
+    # both pinned: the tier runs over budget rather than dropping a pin
+    assert tier.contains(1, _blob(1).tokens)
+    assert tier.contains(2, _blob(2).tokens)
+    assert tier.stats()["used_bytes"] > tier.capacity_bytes
+
+
+# -- Redis cold tier ----------------------------------------------------------
+class FakeRedis:
+    """Minimal redis-py twin (same surface the gated-driver tests use)."""
+
+    def __init__(self, host=None, port=None, db=0, decode_responses=False):
+        self.store: Dict[str, Any] = {}
+
+    def ping(self):
+        return True
+
+    def set(self, key, value, ex=None, px=None):
+        self.store[key] = str(value)
+
+    def get(self, key):
+        return self.store.get(key)
+
+    def delete(self, *keys):
+        return sum(1 for k in keys if self.store.pop(k, None) is not None)
+
+    def close(self):
+        pass
+
+
+def _redis_store(monkeypatch):
+    from gofr_tpu.config import MockConfig
+    from gofr_tpu.datasource.kvredis import RedisKVStore
+
+    mod = types.ModuleType("redis")
+    mod.Redis = FakeRedis
+    monkeypatch.setitem(sys.modules, "redis", mod)
+    return RedisKVStore(MockConfig({}), MockLogger(), None)
+
+
+@pytest.mark.tiercache
+def test_redis_tier_roundtrip_through_real_store(monkeypatch):
+    """PageBlob -> encode -> RedisKVStore string wire -> decode, bit-equal
+    out the other side — against the REAL datasource adapter over the
+    fake driver, so the str(value) storage and decode_responses=True
+    string wire are both in the loop."""
+    store = _redis_store(monkeypatch)
+    tier = RedisKVTier(store, write_behind=False)
+    blob = _blob(3)
+    tier.put(11, blob)
+    out = tier.get(11, blob.tokens)
+    assert out is not None
+    np.testing.assert_array_equal(out.k, blob.k)
+    np.testing.assert_array_equal(out.v, blob.v)
+    assert tier.stats()["stored"] == 1 and tier.stats()["hits"] == 1
+    # wrong tokens: corrupt counted, entry purged, clean miss after
+    assert tier.get(11, [0] * PS) is None
+    assert tier.stats()["corrupt"] == 1
+    assert tier.get(11, blob.tokens) is None
+
+
+@pytest.mark.tiercache
+def test_redis_tier_down_store_counts_errors(monkeypatch):
+    monkeypatch.setitem(sys.modules, "redis", None)
+    from gofr_tpu.config import MockConfig
+    from gofr_tpu.datasource.kvredis import RedisKVStore
+
+    tier = RedisKVTier(RedisKVStore(MockConfig({}), MockLogger(), None),
+                       write_behind=False)
+    tier.put(1, _blob(1))
+    assert tier.get(1, _blob(1).tokens) is None
+    st = tier.stats()
+    assert st["errors"] == 2 and st["stored"] == 0
+
+
+@pytest.mark.tiercache
+def test_host_tier_cold_fallthrough_and_promote(monkeypatch):
+    """Warm eviction lands in the cold tier (write-behind drained), a cold
+    hit restores AND promotes back into host RAM."""
+    store = _redis_store(monkeypatch)
+    cold = RedisKVTier(store, write_behind=True, queue_depth=8)
+    tier = HostKVTier(capacity_bytes=1024 + 256, page_size=PS, cold=cold)
+    tier.put(1, _blob(1, nbytes=1024))
+    tier.put(2, _blob(2, nbytes=1024))       # evicts 1 -> cold queue
+    cold.flush()
+    time.sleep(0.05)                         # let the writer finish the set
+    assert cold.stats()["stored"] == 1
+    out = tier.get(1, _blob(1).tokens)       # warm miss -> cold hit
+    np.testing.assert_array_equal(out.k, _blob(1).k)
+    assert tier.stats()["redis"]["hits"] == 1
+    # promoted: now a WARM hit without touching the cold tier again
+    assert tier.contains(1, _blob(1).tokens)
+
+
+# -- engine lane: restore is bit-equal to recompute ---------------------------
+def _tier_engine(q8: bool = False, **kw):
+    from gofr_tpu.models.llama import LlamaConfig, llama_init
+    from gofr_tpu.tpu.paging import PagedLLMEngine
+
+    cfg = LlamaConfig.debug()
+    if q8:
+        cfg = dataclasses.replace(cfg, kv_dtype="int8")
+    base = dict(n_slots=4, max_seq_len=128, prefill_buckets=(8, 32, 64),
+                decode_block_size=4, page_size=PS, prefix_cache=True,
+                n_pages=10, kv_host_tier_bytes=64 << 20, logger=MockLogger())
+    base.update(kw)
+    eng = PagedLLMEngine(llama_init(cfg, seed=0), cfg, **base)
+    eng.start()
+    return eng
+
+
+SYSTEM = list(range(1, 33)) + [40, 41]     # 4 full pages + a 2-token tail
+
+
+def _evict_with_traffic(eng, rng_base: int = 100):
+    """Fill the 10-page pool with fresh prompts until the SYSTEM trunk's
+    idle pages are evicted (spilled)."""
+    for i in range(6):
+        toks = [rng_base + 7 * i + j for j in range(17)]
+        eng.generate([t % 250 + 1 for t in toks], max_new_tokens=6,
+                     temperature=0.0)
+        if eng._kv_spilled >= 4:
+            break
+    assert eng._kv_spilled > 0, "traffic never evicted the trunk"
+
+
+@pytest.mark.parametrize("q8", [False, True], ids=["bf16", "int8"])
+def test_restore_bit_equal_to_recompute(q8):
+    """THE tentpole gate: generate from a cold prefill, evict the prompt's
+    pages to the host tier, re-send the same prompt (restore path), and
+    require the outputs bit-equal. Any KV corruption in the spill ->
+    host blob -> H2D restore cycle changes the decoded tokens."""
+    eng = _tier_engine(q8=q8)
+    try:
+        golden = eng.generate(SYSTEM, max_new_tokens=8, temperature=0.0)
+        _evict_with_traffic(eng)
+        restored_before = eng._kv_restored
+        again = eng.generate(SYSTEM, max_new_tokens=8, temperature=0.0)
+        assert eng._kv_restored > restored_before, \
+            "repeat prompt never exercised the restore path"
+        assert again == golden, "restored KV diverged from recompute"
+    finally:
+        eng.stop()
+
+
+def test_restore_from_redis_cold_tier_bit_equal(monkeypatch):
+    """Pool pages through the FULL depth: a host tier that fits ~1.5 page
+    blobs (debug pool: 4 KiB/page) pushes almost every spill onward into
+    Redis blobs (base64 JSON over the fake driver), then the repeat prompt
+    restores from the cold side — the dtype round-trip the wire format
+    must preserve exactly."""
+    store = _redis_store(monkeypatch)
+    cold = RedisKVTier(store, write_behind=False)
+    eng = _tier_engine(kv_host_tier_bytes=6144, kv_redis=cold)
+    try:
+        golden = eng.generate(SYSTEM, max_new_tokens=8, temperature=0.0)
+        _evict_with_traffic(eng)
+        again = eng.generate(SYSTEM, max_new_tokens=8, temperature=0.0)
+        assert again == golden
+        tier = eng.kv_tier.stats()
+        assert tier["redis"]["stored"] > 0, "nothing reached the cold tier"
+        assert tier["redis"]["hits"] > 0, "restore never read the cold tier"
+    finally:
+        eng.stop()
+
+
+def test_conversation_pin_survives_tier_churn():
+    """pin_conversation protects a trunk's host blobs from tier LRU: after
+    pinning, churn that would evict the trunk from host RAM leaves it
+    restorable."""
+    eng = _tier_engine()
+    try:
+        golden = eng.generate(SYSTEM, max_new_tokens=8, temperature=0.0)
+        pinned = eng.pin_conversation("conv-1", SYSTEM)
+        assert pinned == len(SYSTEM) // PS
+        _evict_with_traffic(eng)
+        again = eng.generate(SYSTEM, max_new_tokens=8, temperature=0.0)
+        assert again == golden
+        assert eng.kv_tier.stats()["pinned"] >= pinned
+    finally:
+        eng.stop()

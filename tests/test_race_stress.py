@@ -412,6 +412,35 @@ def test_prefix_cache_engine_concurrent_submit_cancel():
         cls=PagedLLMEngine, on_done=assert_no_leaks)
 
 
+def test_paged_engine_tiered_kv_concurrent_submit_cancel():
+    """Spill/restore racing the submit/stream/cancel hammer: prompts
+    DIVERGE in the first page so every one caches its own full pages, and
+    the pool is sized so cached-idle + active demand overflows it — prefix
+    eviction (host-tier spill) and admission-time restore run mid-traffic.
+    Golden-output equality is the correctness gate: a restore that
+    rebuilt the wrong KV breaks bit-equality; the leak gate catches any
+    refcount imbalance on the restored pages' insert/unref cycle."""
+    from gofr_tpu.tpu.paging import PagedLLMEngine
+
+    base = list(range(1, 17))             # 16 tokens = 2 full pages at ps=8
+
+    def assert_no_leaks_and_spilled(eng):
+        freed = eng.prefix.drop_all_idle()
+        eng.allocator.release(freed)
+        assert eng.allocator.used_pages == 0, \
+            f"{eng.allocator.used_pages} pages leaked (refs stuck)"
+        assert eng._kv_spilled > 0, \
+            "pool never spilled — the tier path went unexercised"
+
+    _engine_submit_cancel_stress(
+        dict(n_slots=4, max_seq_len=64, prefill_buckets=(8, 32),
+             page_size=8, prefix_cache=True, n_pages=15,
+             kv_host_tier_bytes=16 << 20),
+        prompts={i: [30 + i] + base for i in range(6)},
+        max_new=6, n_threads=10, rounds=4, cancel_mod=3,
+        cls=PagedLLMEngine, on_done=assert_no_leaks_and_spilled)
+
+
 def test_wedge_recovery_races_concurrent_submitters():
     """Submitters racing wedge onset and recovery: every request must end
     terminal (tokens, EngineStalledError shed, or a cancel) — no client

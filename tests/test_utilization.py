@@ -306,6 +306,10 @@ def test_metric_inventory_consistency():
     # the stepledger module's recording style)
     assert "app_tpu_step_seconds" in recorded
     assert "app_tpu_step_stragglers_total" in recorded
+    # the tiered-KV family must be IN the scan (guards regex rot against
+    # paging.py's spill/restore recording style)
+    assert any(n.startswith("app_tpu_kv_tier_") for n in recorded), \
+        "kv tier counters vanished from the inventory scan"
 
     from gofr_tpu.tpu.device import TPUClient
     from gofr_tpu.tpu.flightrecorder import register_slo_gauges

@@ -82,7 +82,11 @@ def poll_once(server: str, metrics_base: str) -> dict:
                   # crash-only surfaces: breaker state (open = the server
                   # is shedding with 503s) + reset/replay totals
                   "breaker": snap.get("breaker"),
-                  "recovery": snap.get("recovery")}
+                  "recovery": snap.get("recovery"),
+                  # tiered-KV counters (spill/restore/hit/corrupt) ride in
+                  # page_pool.kv_tier; surface them as their own key so a
+                  # grep over the JSONL stream finds tier regressions
+                  "kv_tier": (snap.get("page_pool") or {}).get("kv_tier")}
         compile_table = snap.get("compile") or {}
         # totals only — the per-program rows would bloat the JSONL stream
         engine["compile"] = {k: compile_table.get(k) for k in (

@@ -7,8 +7,9 @@ weak #4: "soak results are claims, not artifacts"):
     python tools/soak.py mixed       # dense engine, chunked prefill
     python tools/soak.py paged-int8  # paged pool, int8 pages + weights
     python tools/soak.py spec        # speculative decoding (paged pool)
+    python tools/soak.py chat        # multi-turn sessions, tiered KV cache
     python tools/soak.py multihost   # two-process live-traffic admission
-    python tools/soak.py all         # the four in sequence
+    python tools/soak.py all         # the five in sequence
     python tools/soak.py all --seconds 180 --threads 6
 
 Each profile boots an engine, runs N seconds of Poisson-arrival traffic
@@ -76,10 +77,23 @@ def _build(profile: str, preset: str, chaos: bool = False):
         params = llama_init(cfg, seed=0)
         return PagedLLMEngine(params, cfg, page_size=16 if small else 128,
                               speculative_tokens=4, prefix_cache=True, **kw)
+    if profile == "chat":
+        # multi-turn sessions over the tiered KV cache: the page pool is
+        # sized SMALL relative to the session trunks so idle histories
+        # spill to host RAM organically and the next turn on that session
+        # exercises restore (H2D scatter) under concurrent submit/cancel
+        params = llama_init(cfg, seed=0)
+        return PagedLLMEngine(params, cfg, page_size=16 if small else 128,
+                              prefix_cache=True,
+                              n_pages=64 if small else 1024,
+                              kv_host_tier_bytes=(32 << 20 if small
+                                                  else 512 << 20),
+                              **kw)
     raise SystemExit(f"unknown profile {profile!r}")
 
 
-def _soak(engine, seconds: float, n_threads: int, vocab: int) -> dict:
+def _soak(engine, seconds: float, n_threads: int, vocab: int,
+          chat_sessions=None) -> dict:
     stats = {"ok": 0, "cancelled": 0, "errors": 0, "shed": 0, "tokens": 0}
     errors = []
     lock = threading.Lock()
@@ -90,12 +104,37 @@ def _soak(engine, seconds: float, n_threads: int, vocab: int) -> dict:
     # random-only traffic would insert but never hit, leaving the
     # spec-verify-over-shared-pages composition unexercised
     shared_prefix = [((7 * i) % (vocab - 1)) + 1 for i in range(40)]
+    history_cap = engine.admission_limit
 
     def worker(idx: int) -> None:
         rng = random.Random(1000 + idx)
         while time.time() < stop_at:
             kind = rng.random()
-            if kind < 0.35:  # self-repetitive: the speculative fast path
+            session = history = None
+            if chat_sessions is not None and kind < 0.8:
+                # multi-turn chat: zipf-ish session pick (a few hot
+                # conversations, a long tail of cold ones), prompt = that
+                # session's WHOLE history + a fresh user turn; completions
+                # append, so trunks grow turn over turn — re-sent growing
+                # prefixes after idle spells are the tier's restore load
+                # 70% zipf (hot head stays HBM-resident), 30% uniform —
+                # the uniform picks revisit COLD sessions whose spilled
+                # trunks must come back through the restore path
+                session = chat_sessions[
+                    rng.randrange(len(chat_sessions))
+                    if rng.random() < 0.3 else
+                    min(int(rng.paretovariate(1.1)) - 1,
+                        len(chat_sessions) - 1)]
+                with lock:
+                    history = list(session["history"])
+                # clamp the new turn to the admission limit: a plateaued
+                # session keeps re-sending its full trunk (pure restore
+                # traffic) instead of erroring out of admission
+                room = max(0, engine.admission_limit - len(history))
+                turn = [rng.randrange(1, vocab)
+                        for _ in range(min(rng.choice([4, 8, 16]), room))]
+                prompt = history + turn
+            elif kind < 0.35:  # self-repetitive: the speculative fast path
                 unit = [rng.randrange(1, vocab) for _ in range(3)]
                 prompt = (unit * 8)[:rng.choice([6, 12, 24, 40])]
             elif kind < 0.65:  # shared-prefix: the prefix-cache fast path
@@ -114,9 +153,10 @@ def _soak(engine, seconds: float, n_threads: int, vocab: int) -> dict:
                 )
                 cancel_after = (rng.randrange(1, 6)
                                 if rng.random() < 0.25 else None)
-                got = 0
+                got, out_toks = 0, []
                 for _tok in req.stream(timeout_s=600):
                     got += 1
+                    out_toks.append(_tok)
                     if cancel_after is not None and got >= cancel_after:
                         req.cancel()
                         with lock:
@@ -125,6 +165,17 @@ def _soak(engine, seconds: float, n_threads: int, vocab: int) -> dict:
                 else:
                     with lock:
                         stats["ok"] += 1
+                    if session is not None:
+                        new_hist = prompt + out_toks
+                        with lock:
+                            # last-writer-wins only when nobody else
+                            # advanced the session meanwhile; plateau at
+                            # the admission limit instead of truncating
+                            # (a truncated head would change every chain
+                            # key and defeat the prefix share)
+                            if (len(session["history"]) == len(history)
+                                    and len(new_hist) <= history_cap):
+                                session["history"] = new_hist
                 with lock:
                     stats["tokens"] += got
             except Exception as exc:  # noqa: BLE001 - the soak gate itself
@@ -207,9 +258,19 @@ def run_profile(profile: str, seconds: float, n_threads: int,
         arm_timer.start()
     engine.start()
     engine.warmup()
+    chat_sessions = None
+    if profile == "chat":
+        # 16 sessions, each born with a short system-prompt-ish history;
+        # the zipf pick in _soak concentrates turns on the first few
+        seed_rng = random.Random(7)
+        chat_sessions = [
+            {"history": [seed_rng.randrange(1, engine.cfg.vocab_size)
+                         for _ in range(24)]}
+            for _ in range(16)]
     t0 = time.time()
     try:
-        stats = _soak(engine, seconds, n_threads, engine.cfg.vocab_size)
+        stats = _soak(engine, seconds, n_threads, engine.cfg.vocab_size,
+                      chat_sessions=chat_sessions)
         drained = engine.drain(timeout_s=120)
     finally:
         engine.stop()
@@ -297,6 +358,15 @@ def run_profile(profile: str, seconds: float, n_threads: int,
         stats["chaos"]["breaker_open_incidents"] = breaker_incidents
         ok = ok and breaker_incidents >= 1 \
             and stats["chaos"]["breaker"]["state"] == "closed"
+    # tiered-KV axis: spill/restore/hit counters from the soak's organic
+    # eviction traffic (captured BEFORE the leak check below drops idle
+    # pages — that teardown path bypasses spill by design)
+    kv_tier = getattr(engine, "kv_tier", None)
+    if kv_tier is not None:
+        tier = kv_tier.stats()
+        tier["spilled_pages"] = engine._kv_spilled
+        tier["restored_pages"] = engine._kv_restored
+        stats["kv_tier"] = tier
     leaked = None
     if hasattr(engine, "allocator"):
         prefix = getattr(engine, "prefix", None)
@@ -372,8 +442,8 @@ def run_multihost(seconds: float) -> bool:
 def main() -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("profile", nargs="?", default="all",
-                        choices=["mixed", "paged-int8", "spec", "multihost",
-                                 "all"])
+                        choices=["mixed", "paged-int8", "spec", "chat",
+                                 "multihost", "all"])
     parser.add_argument("--seconds", type=float, default=120.0)
     parser.add_argument("--threads", type=int, default=4)
     parser.add_argument("--chaos", action="store_true",
@@ -389,7 +459,7 @@ def main() -> int:
         jax.config.update("jax_platforms", platform)
     preset = os.environ.get("SOAK_PRESET", "debug")
 
-    profiles = (["mixed", "paged-int8", "spec", "multihost"]
+    profiles = (["mixed", "paged-int8", "spec", "chat", "multihost"]
                 if args.profile == "all" else [args.profile])
     results = []
     for p in profiles:
