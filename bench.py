@@ -1126,6 +1126,124 @@ def main() -> None:
         record.update(kv_tier_error=f"{type(exc).__name__}: {exc}"[:200])
         _note_wedge(exc, record, "KV")
 
+    # ---- DG: disaggregated prefill/decode — TPOT under prefill churn ------
+    # The split's before/after evidence: per-token latency of decode-heavy
+    # victim streams while prompt churn runs concurrently, measured
+    # client-side the same way on both arms. Colocated interleaves every
+    # churn prompt's prefill into the victims' decode loop; the split
+    # pair's decode pool never dispatches one (asserted against its step
+    # ledger below), so churn costs only kv_handoff admissions.
+    try:
+        if full_run and _left() > 300 and not _WEDGED:
+            from gofr_tpu.tpu.disagg import DisaggRouter
+            from gofr_tpu.tpu.paging import PagedLLMEngine
+
+            dg_seq = min(512, max_seq)
+            dg_bucket = max(b for b in prefill_buckets if b <= dg_seq)
+            churn_len = max(dg_bucket - 16, 8)
+
+            def _victim_tpots_ms(submit_fn):
+                """Mean client-observed TPOT of 3 victim streams decoding
+                under continuous 2-wide prompt churn."""
+                stop = threading.Event()
+
+                def _churn():
+                    while not stop.is_set():
+                        batch = []
+                        for _ in range(2):
+                            try:
+                                batch.append(submit_fn(
+                                    rng.integers(
+                                        1, cfg.vocab_size,
+                                        size=churn_len).tolist(),
+                                    max_new_tokens=2, temperature=0.0))
+                            except Exception:  # noqa: BLE001 - shed = wait
+                                time.sleep(0.05)
+                        for r in batch:
+                            try:
+                                r.result(timeout_s=TOKEN_TIMEOUT_S)
+                            except Exception:  # noqa: BLE001
+                                pass
+
+                def _stream(req, out, i):
+                    t_first = t_last = None
+                    n = 0
+                    for _tok in req.stream(timeout_s=TOKEN_TIMEOUT_S):
+                        t_last = time.monotonic()
+                        if t_first is None:
+                            t_first = t_last
+                        n += 1
+                    if n >= 2:
+                        out[i] = (t_last - t_first) / (n - 1) * 1e3
+
+                churner = threading.Thread(target=_churn, daemon=True)
+                churner.start()
+                time.sleep(0.3)  # churn in flight before victims arrive
+                victims = [submit_fn(
+                    rng.integers(1, cfg.vocab_size, size=8).tolist(),
+                    max_new_tokens=32, temperature=0.0) for _ in range(3)]
+                tpots = [None] * len(victims)
+                streamers = [threading.Thread(target=_stream,
+                                              args=(v, tpots, i),
+                                              daemon=True)
+                             for i, v in enumerate(victims)]
+                for s in streamers:
+                    s.start()
+                for s in streamers:
+                    s.join(timeout=TOKEN_TIMEOUT_S)
+                stop.set()
+                churner.join(timeout=TOKEN_TIMEOUT_S)
+                good = [t for t in tpots if t is not None]
+                if not good:
+                    raise RuntimeError("no victim stream finished")
+                return sum(good) / len(good)
+
+            colo = make_engine(6, dg_seq, cfg, cls=PagedLLMEngine,
+                               page_size=64)
+            try:
+                tpot_colo = _victim_tpots_ms(colo.submit)
+            finally:
+                colo.stop()
+            dg_pre = make_engine(2, dg_seq, cfg, cls=PagedLLMEngine,
+                                 page_size=64, disagg_role="prefill")
+            dg_dec = make_engine(6, dg_seq, cfg, cls=PagedLLMEngine,
+                                 page_size=64, disagg_role="decode")
+            router = DisaggRouter(dg_pre, dg_dec, metrics=manager)
+            router.start()
+            try:
+                tpot_disagg = _victim_tpots_ms(router.submit)
+                snap = dg_dec.steps.snapshot()
+                decode_pool_prefills = sum(
+                    1 for s in snap["recent"] if s["phase"] == "prefill")
+                dg_handoffs = dg_pre.handoffs_total
+                dg_fallbacks = (router.fallbacks_total
+                                + dg_pre.handoff_fallbacks_total
+                                + dg_dec.handoff_fallbacks_total)
+            finally:
+                router.stop()
+                dg_pre.stop()
+                dg_dec.stop()
+            print(f"[bench] DG interference: colocated TPOT "
+                  f"{tpot_colo:.2f}ms vs disagg {tpot_disagg:.2f}ms "
+                  f"({dg_handoffs} handoffs, {dg_fallbacks} fallbacks, "
+                  f"{decode_pool_prefills} decode-pool prefill steps) "
+                  f"t={_spent():.0f}s", file=sys.stderr)
+            record.update(
+                tpot_interference_ms_colocated=round(tpot_colo, 2),
+                tpot_interference_ms_disagg=round(tpot_disagg, 2),
+                disagg_tpot_win_ms=round(tpot_colo - tpot_disagg, 2),
+                disagg_handoffs=dg_handoffs,
+                disagg_fallbacks=dg_fallbacks,
+                disagg_decode_pool_prefill_steps=decode_pool_prefills)
+        elif full_run:
+            record.update(disagg_skipped=("device wedged" if _WEDGED
+                                          else "budget"))
+    except Exception as exc:  # noqa: BLE001 - keep earlier phases' record
+        print(f"[bench] DG phase failed (earlier results preserved): "
+              f"{exc}", file=sys.stderr)
+        record.update(disagg_error=f"{type(exc).__name__}: {exc}"[:200])
+        _note_wedge(exc, record, "DG")
+
     # ---- T2: structured-text speculation (labeled extra, never headline) --
     # Speculative decoding cannot help the random-token phases (no self-
     # repetition to draft from), so measure it on an honest STRUCTURED

@@ -213,8 +213,21 @@ def build_engine(app: App, default_sampling_controls: bool = False) -> LLMEngine
     budget_cfg = app.config.get_int("HBM_BUDGET_BYTES", 0)
     budget = (0 if budget_cfg < 0
               else budget_cfg or device_budget_bytes(tpu))
-    engine = engine_cls(
-        params, cfg,
+    # DISAGG_MODE splits serving into a prefill pool and a decode pool
+    # (tpu/disagg.py): "both" builds the split pair in-process behind a
+    # DisaggRouter (the single-host deployment), "prefill"/"decode" build
+    # one engine in that role for operator-wired pairs. Requires PAGED —
+    # the hand-off ships KV page blobs.
+    disagg_mode = app.config.get_or_default("DISAGG_MODE", "off").lower()
+    if disagg_mode not in ("off", "prefill", "decode", "both"):
+        raise ValueError(f"DISAGG_MODE must be off|prefill|decode|both, "
+                         f"got {disagg_mode!r}")
+    if disagg_mode != "off":
+        if engine_cls is LLMEngine:
+            raise ValueError("DISAGG_MODE requires PAGED=true")
+        paged_kw["disagg_role"] = ("decode" if disagg_mode == "both"
+                                   else disagg_mode)
+    engine_kw = dict(
         n_slots=app.config.get_int("MAX_BATCH", 8),
         max_seq_len=app.config.get_int("MAX_SEQ_LEN", 1024),
         budget_bytes=budget or None,
@@ -255,6 +268,7 @@ def build_engine(app: App, default_sampling_controls: bool = False) -> LLMEngine
         finisher_queue=app.config.get_int("ENGINE_FINISHER_QUEUE", 256),
         **paged_kw,
     )
+    engine = engine_cls(params, cfg, **engine_kw)
     engine.tokenizer = tokenizer
     engine.start()
     # graceful drain: finish active generations (bounded) before the HTTP
@@ -278,6 +292,48 @@ def build_engine(app: App, default_sampling_controls: bool = False) -> LLMEngine
         n = engine.warmup_scoring()
         app.logger.infof("scoring warmed up in %.1fs (%d passes)",
                          time.time() - t0, n)
+    if disagg_mode == "both":
+        from gofr_tpu.tpu.disagg import (DisaggRouter, PubSubTransport,
+                                         register_disagg_metrics)
+
+        # the prefill twin shares the decode pool's params (the same
+        # read-only arrays — no second weight copy in HBM) and config;
+        # DISAGG_PREFILL_SLOTS sizes its admission width independently
+        prefill_kw = dict(engine_kw, disagg_role="prefill")
+        n_pre = app.config.get_int("DISAGG_PREFILL_SLOTS", 0)
+        if n_pre:
+            prefill_kw["n_slots"] = n_pre
+        prefill_engine = engine_cls(params, cfg, **prefill_kw)
+        prefill_engine.tokenizer = tokenizer
+        prefill_engine.start()
+        if warm_mode not in ("false", "0", "no", "off"):
+            prefill_engine.warmup(k_variants=warm_mode == "wide")
+        # DISAGG_TRANSPORT=pubsub ships hand-offs over the app's broker
+        # (commit-to-advance); the default is the bounded in-proc queue
+        transport = None
+        if app.config.get_or_default("DISAGG_TRANSPORT",
+                                     "queue") == "pubsub":
+            broker = getattr(app.container, "pubsub", None)
+            if broker is not None:
+                transport = PubSubTransport(broker)
+        router = DisaggRouter(
+            prefill_engine, engine,
+            metrics=app.container.metrics_manager,
+            transport=transport,
+            queue_depth=app.config.get_int("DISAGG_QUEUE_DEPTH", 64),
+            handoff_timeout_s=app.config.get_float(
+                "DISAGG_HANDOFF_TIMEOUT_S", 10.0))
+        if app.container.metrics_manager is not None:
+            register_disagg_metrics(app.container.metrics_manager)
+        router.start()
+        # the router is the front door; build_app routes submits through
+        # it (and /debug/disagg onto it) whenever the engine carries one
+        engine.disagg_router = router
+        app.container.add_health_contributor("prefill_engine",
+                                             prefill_engine.health_check)
+        app.on_shutdown(lambda: (router.stop(), prefill_engine.drain(
+            app.config.get_float("DRAIN_TIMEOUT", 30.0)),
+            prefill_engine.stop()))
     # /.well-known/health reports the engine next to the datasources: a
     # wedged device (loop stuck in a PJRT call) degrades the aggregate so
     # load balancers stop routing here, matching submit()'s 503 shed.
@@ -391,8 +447,18 @@ def build_app(config=None, engine=None) -> App:
     # zero-overhead faults=None fast path and the endpoint 404s
     app.enable_fault_injection(engine)
     tokenizer: ByteTokenizer = engine.tokenizer
+    # disaggregated pair (DISAGG_MODE=both): the router is the front door
+    # — prefill pool runs the prompt, decode pool streams the rest — and
+    # its hand-off plane reports at GET /debug/disagg. submit() has the
+    # engine's signature, so every surface below is split-agnostic
+    router = getattr(engine, "disagg_router", None)
+    if router is not None:
+        from gofr_tpu.tpu.disagg import install_routes as _disagg_routes
+
+        _disagg_routes(app, router)
+    submitter = router if router is not None else engine
     # token streaming over gRPC rides the same engine (GRPC_PORT)
-    app.register_grpc_service(build_generate_service(engine, tokenizer))
+    app.register_grpc_service(build_generate_service(submitter, tokenizer))
 
     @app.post("/generate")
     def generate(ctx):
@@ -417,7 +483,7 @@ def build_app(config=None, engine=None) -> App:
             raise InvalidParam(["priority", "min_tokens", "top_p",
                                 "top_k"]) from exc
         try:
-            request = engine.submit(
+            request = submitter.submit(
                 tokenizer.encode(prompt), max_new_tokens=max_tokens,
                 temperature=temperature, stop_tokens={tokenizer.EOS},
                 span=ctx.span,  # batch.id/slot correlation lands on span

@@ -91,6 +91,14 @@ def build_app(**kw) -> App:
         app.enable_incident_autopsy(engine)
     # chaos plane (llm-server parity): 404s unless FAULT_INJECTION=true
     app.enable_fault_injection(engine)
+    # disaggregated pair (DISAGG_MODE=both, llm-server parity): submits go
+    # through the router's prefill/decode split; GET /debug/disagg
+    router = getattr(engine, "disagg_router", None)
+    if router is not None:
+        from gofr_tpu.tpu.disagg import install_routes as _disagg_routes
+
+        _disagg_routes(app, router)
+    submitter = router if router is not None else engine
     tokenizer = engine.tokenizer
     model_id = app.config.get_or_default("MODEL_PRESET", "debug")
 
@@ -169,14 +177,14 @@ def build_app(**kw) -> App:
         # the flight recorder's engine child spans (queue/prefill/decode)
         # share the inbound trace id
         try:
-            return engine.submit(prompt_tokens, max_new_tokens=max_tokens,
-                                 temperature=temperature,
-                                 stop_tokens={tokenizer.EOS},
-                                 span=ctx.span if ctx is not None else None,
-                                 traceparent=(ctx.request.traceparent
-                                              if ctx is not None else None),
-                                 min_tokens=min_tokens, top_p=top_p,
-                                 top_k=top_k)
+            return submitter.submit(
+                prompt_tokens, max_new_tokens=max_tokens,
+                temperature=temperature,
+                stop_tokens={tokenizer.EOS},
+                span=ctx.span if ctx is not None else None,
+                traceparent=(ctx.request.traceparent
+                             if ctx is not None else None),
+                min_tokens=min_tokens, top_p=top_p, top_k=top_k)
         except ValueError:
             raise
         except Exception as exc:  # noqa: BLE001 - sheds → 503 + Retry-After

@@ -172,6 +172,14 @@ class GenerationRequest:
         # device-reset re-admissions consumed (bounded by the engine's
         # retry_budget; crossing it fails the request instead)
         self.replays = 0
+        # disaggregated serving (tpu/disagg.py): True on requests admitted
+        # through submit_handoff — their prefill (and first token) already
+        # happened on the prefill pool. handoff_blobs holds the shipped
+        # per-page KV (kvtier.PageBlob list) until admission lands it in
+        # the pool; None means recompute the resume window (the degraded
+        # path for a lost or failed-verification hand-off)
+        self.disagg_handoff = False
+        self.handoff_blobs = None
 
     @property
     def resume_tokens(self) -> List[int]:
@@ -444,6 +452,8 @@ class LLMEngine:
         faults=None,
         async_d2h: bool = True,
         finisher_queue: int = 256,
+        disagg_role: str = "",
+        handoff_sink=None,
     ):
         """mesh: optional jax.sharding.Mesh with a "tp" axis. When given, the
         engine serves TENSOR-PARALLEL: params shard per serving_param_specs
@@ -707,6 +717,42 @@ class LLMEngine:
                         f"chunk_prefill_tokens={self.chunk_prefill_tokens} "
                         f"must divide prefill bucket {bucket}")
         self._chunk_jobs: "collections.deque" = collections.deque()
+
+        # disaggregated prefill/decode (tpu/disagg.py): "" = colocated
+        # serving (the default, zero overhead on every hot path below),
+        # "prefill" = this engine runs prompt ingestion only and EXPORTS
+        # each finished prompt's KV to a hand-off sink instead of ever
+        # entering decode, "decode" = this engine accepts pre-filled-KV
+        # admissions (submit_handoff) and only dispatches a prefill as the
+        # lost-hand-off recompute fallback. KV ships page-granular
+        # (kvtier.PageBlob), so both roles require the paged engine.
+        self.disagg_role = str(disagg_role or "")
+        if self.disagg_role not in ("", "prefill", "decode"):
+            raise ValueError(f"disagg_role={disagg_role!r}: "
+                             f"use '', 'prefill' or 'decode'")
+        if self.disagg_role and not self._plan_paged:
+            raise ValueError("disaggregated serving requires the paged "
+                             "engine (KV hands off as page blobs)")
+        if self.disagg_role and admission_plane is not None:
+            raise ValueError(
+                "disaggregated roles are single-controller only; the "
+                "multi-host admission plane cannot mirror hand-offs")
+        # prefill role: called on the LOOP thread as sink(request, blobs,
+        # n_ctx) right after the first token was emitted; returns True when
+        # the hand-off was delivered (False = the sink already arranged the
+        # fallback). Set at construction by disagg.PrefillWorker.
+        self._handoff_sink = handoff_sink
+        # prefill role: a failing request is offered to this hook first
+        # (disagg.PrefillWorker wires it); True means the worker took
+        # ownership of the stream — fallback recompute on the decode pool
+        # — so the engine must NOT set an error or deliver the terminal
+        # None (the client's stream continues elsewhere)
+        self._handoff_fail = None
+        # lifetime hand-off evidence (plain ints, loop-thread writes):
+        # /debug/disagg and the soak artifacts read these even when
+        # metrics is None
+        self.handoffs_total = 0
+        self.handoff_fallbacks_total = 0
 
         # in-flight dispatches awaiting host sync, processed FIFO:
         #   ("decode", out_tokens [B, M] future, [(slot_idx, request)], M)
@@ -1003,6 +1049,108 @@ class LLMEngine:
 
     def generate(self, prompt_tokens: Sequence[int], **kw) -> List[int]:
         return self.submit(prompt_tokens, **kw).result()
+
+    def submit_handoff(self, prompt_tokens: Sequence[int],
+                       emitted: Sequence[int], *,
+                       max_new_tokens: int = 128, temperature: float = 0.0,
+                       stop_tokens: Optional[Set[int]] = None,
+                       priority: int = 0, min_tokens: int = 0,
+                       top_p: float = 0.0, top_k: int = 0,
+                       traceparent: Optional[str] = None,
+                       out_queue=None, cancelled=None,
+                       blobs=None) -> GenerationRequest:
+        """Admit a generation whose prefill (and first token) already ran
+        on another engine — the decode half of disaggregated serving
+        (tpu/disagg.py), built on the replay-after-reset contract: the
+        request admits at ``prompt + emitted`` with its REMAINING budget
+        and nothing already delivered is ever re-emitted.
+
+        blobs (one kvtier.PageBlob per full-or-partial prompt page, paged
+        decode-role engines only) short-circuits the prefill recompute:
+        admission validates each blob against this pool's shape/dtype,
+        lands the KV with the donated H2D scatter under the ``kv_handoff``
+        step segment, and the slot binds straight into decode. blobs=None
+        is the degraded path — a normal prefill of the resume window
+        (exactly a replay), used when a hand-off was lost, corrupt, or
+        failed shape verification.
+
+        out_queue: the client-facing token queue (the prefill-side
+        request's), shared so the stream continues seamlessly across the
+        hop. cancelled: the prefill-side request's cancellation event, so
+        a client cancel reaches whichever pool currently owns the slot.
+        traceparent keeps both pools' spans on one trace."""
+        if self._stop.is_set():
+            raise RuntimeError("engine is stopped")
+        if self._draining:
+            raise EngineDrainingError()
+        stall = self._stall_over_threshold()
+        if stall:
+            if self.recorder is not None:
+                self.recorder.record_engine_event("stall_shed",
+                                                  stall_s=round(stall, 1))
+            raise EngineStalledError(stall)
+        retry_after = self.breaker.reject_for()
+        if retry_after is not None:
+            if self.recorder is not None:
+                self.recorder.record_engine_event(
+                    "breaker_shed", state=self.breaker.state)
+            raise DeviceLostError(retry_after)
+        if not prompt_tokens:
+            raise ValueError("prompt_tokens must be non-empty")
+        if blobs is not None and self.disagg_role != "decode":
+            raise ValueError("KV blobs require disagg_role='decode'")
+        if (top_p or top_k) and not self.sampling_controls:
+            raise ValueError("per-request top_p/top_k need an engine built "
+                             "with sampling_controls=True")
+        emitted = list(emitted)
+        if max_new_tokens - len(emitted) <= 0:
+            raise ValueError("hand-off carries no remaining budget; the "
+                             "prefill pool should have finished it")
+        if len(prompt_tokens) + len(emitted) > self.admission_limit:
+            raise ValueError(
+                f"resume window of {len(prompt_tokens) + len(emitted)} "
+                f"tokens exceeds the admission limit "
+                f"({self.admission_limit})")
+        # hand-offs outrank queued fresh arrivals (LOWER admits first,
+        # clients are clamped >= 0), mirroring replay: the prompt's
+        # prefill was already paid for and its client is mid-stream
+        request = GenerationRequest(prompt_tokens, max_new_tokens,
+                                    temperature, stop_tokens,
+                                    priority=min(int(priority), -1),
+                                    min_tokens=min_tokens, top_p=top_p,
+                                    top_k=top_k, traceparent=traceparent)
+        request.disagg_handoff = True
+        request.handoff_blobs = blobs
+        request.generated = len(emitted)
+        request.emitted = emitted
+        if emitted:
+            # the client saw its first token on the PREFILL pool; stamping
+            # here keeps TTFT single-counted and anchors this record's
+            # decode-side TPOT at hand-off receipt
+            request.first_token_at = request.enqueued_at
+        if out_queue is not None:
+            request.out_queue = out_queue
+        if cancelled is not None:
+            request.cancelled = cancelled
+        if self.tracer is not None:
+            request.gen_span = self.tracer.start_span(
+                "tpu.generate", traceparent=traceparent)
+            request.gen_span.set_attribute("tpu.prompt_tokens",
+                                           len(request.prompt_tokens))
+            request.gen_span.set_attribute("disagg.handoff", True)
+        if self.recorder is not None:  # after gen_span: trace continuity
+            self.recorder.record_enqueued(request)
+            self.recorder.record_event(
+                request.id, "handoff_received",
+                pages=len(blobs) if blobs else 0,
+                resume_tokens=len(request.resume_tokens))
+        self._pending.put((request.priority, request.id, request))
+        if self._stop.is_set():
+            self._drain_pending(RuntimeError("engine stopped"))
+            raise RuntimeError("engine is stopped")
+        self._obs.gauge("app_tpu_queue_depth", self._pending.qsize())
+        self._wake.set()
+        return request
 
     def score(self, prompt_tokens: Sequence[int],
               completion_tokens: Sequence[int], top: int = 5):
@@ -1806,6 +1954,20 @@ class LLMEngine:
                     # long prompt's remaining chunks
                     self._advance_chunk_job()
                     any_active = any(slot.active for slot in self.slots)
+                    if any_active and self.disagg_role == "prefill":
+                        # slots on a prefill pool evacuate at prefill
+                        # sync (_handoff_slot), so decode steps pipelined
+                        # behind a pending prefill would demux to nothing
+                        # — pure garbage dispatches stealing device time
+                        # from the next prompt. Dispatch decode ONLY for
+                        # a slot with no prefill in flight: the last-
+                        # resort case where a failed export kept the slot
+                        # bound and this pool decodes it locally
+                        pending = {i for e in self._inflight
+                                   if e[0] == "prefill" for i, _ in e[2]}
+                        any_active = any(
+                            slot.active and i not in pending
+                            for i, slot in enumerate(self.slots))
                     if self.speculative_tokens and self._spec_cooloff <= 0:
                         # one verify at a time (the next window's start
                         # depends on this one's acceptance), and NOT until
@@ -2045,6 +2207,17 @@ class LLMEngine:
         if not taken:
             return
 
+        # disaggregated decode pool: hand-off arrivals bypass the prefill
+        # bucket path entirely — their shipped KV lands under kv_handoff
+        # and the slot binds straight into decode (tpu/disagg.py). A
+        # fallback inside _admit_handoff re-parks the request blob-less,
+        # so the next round admits it below as a normal recompute.
+        handed: List[GenerationRequest] = []
+        if self.disagg_role == "decode":
+            handed = [r for r in taken if r.handoff_blobs is not None]
+            if handed:
+                taken = [r for r in taken if r.handoff_blobs is None]
+
         # group by admission bucket (the paged engine's prefix cache may
         # shrink a request's window to its un-cached tail), then split
         # counts into powers of two
@@ -2056,6 +2229,8 @@ class LLMEngine:
         free_iter = iter(free)
         dispatched: Set[int] = set()
         try:
+            if handed:
+                self._admit_handoff(handed, free_iter, dispatched)
             for bucket, group in by_bucket.items():
                 offset = 0
                 for K in _admission_split(len(group), self.n_slots):
@@ -2088,7 +2263,7 @@ class LLMEngine:
         except Exception as exc:
             # fail requests that never reached a dispatch (dispatched ones
             # hold slots and are failed by the caller's device-state reset)
-            for request in taken:
+            for request in itertools.chain(taken, handed):
                 if request.id not in dispatched:
                     self._abort_admission(request)
                     self._fail_request(request, exc)
@@ -2391,6 +2566,12 @@ class LLMEngine:
                 if (request.hit_stop(token) or slot.remaining <= 0
                         or self._is_cancelled(request)):
                     self._finish_slot(slot)
+                elif self.disagg_role == "prefill":
+                    # disaggregated prefill pool: the slot never enters
+                    # decode — export the finished prompt's KV and hand
+                    # the stream to the decode pool (tpu/disagg.py). The
+                    # first token above is this pool's whole TTFT job.
+                    self._handoff_slot(slot, request)
             if n_first:
                 self._obs.counter("app_tpu_tokens_generated_total",
                                   float(n_first))
@@ -2559,8 +2740,17 @@ class LLMEngine:
     def _fail_request(self, request: GenerationRequest,
                       exc: Optional[BaseException] = None) -> None:
         """Terminate a request that never reached (or lost) a slot: close
-        its generation span and unblock its consumer."""
-        if exc is not None:
+        its generation span and unblock its consumer.
+
+        Disaggregated prefill pool: the failure is offered to the hand-off
+        fail hook first (disagg.PrefillWorker). When the hook takes it, the
+        stream is NOT over — the worker re-routes it to the decode pool as
+        a recompute from prompt + emitted — so no error lands on the
+        request object (the client shares it) and no terminal None is
+        delivered; the prefill-side span and flight record still close."""
+        handled = (self._handoff_fail is not None
+                   and self._handoff_fail(request, exc))
+        if exc is not None and not handled:
             request.error = exc
         if request.finished_at is None:  # terminal either way: consumers
             request.finished_at = time.monotonic()  # and the admission
@@ -2570,13 +2760,17 @@ class LLMEngine:
                 request.gen_span.set_status(False, str(request.error))
             elif request.cancelled.is_set():
                 request.gen_span.set_attribute("cancelled", True)
+            if handled:
+                request.gen_span.set_attribute("disagg.fallback", True)
             request.gen_span.end()
         if self.recorder is not None:
             self.recorder.record_finished(
-                request, "error" if request.error is not None
-                else ("cancelled" if request.cancelled.is_set()
-                      else "aborted"))
-        request.out_queue.put(None)
+                request, "handoff" if handled
+                else ("error" if request.error is not None
+                      else ("cancelled" if request.cancelled.is_set()
+                            else "aborted")))
+        if not handled:
+            request.out_queue.put(None)
 
     def _emit_block(self, request: GenerationRequest,
                     tokens: List[int]) -> None:
@@ -2880,6 +3074,41 @@ class LLMEngine:
     def _abort_admission(self, request: GenerationRequest) -> None:
         """Subclass hook: release _admission_ready reservations for a
         request that exits without reaching a dispatch."""
+
+    def _admit_handoff(self, batch: List[GenerationRequest], free_iter,
+                       dispatched: Set[int]) -> None:
+        """Subclass hook (paged): bind hand-off requests whose KV arrived
+        as page blobs straight into decode slots. Base engines never see
+        them — submit_handoff rejects blobs off the paged decode role."""
+        raise NotImplementedError(
+            "page-blob hand-off admission needs the paged engine")
+
+    def _handoff_slot(self, slot: _Slot, request: GenerationRequest) -> None:
+        """Subclass hook (paged): export a freshly-prefilled slot's KV to
+        the hand-off sink and release the slot WITHOUT terminating the
+        stream. Only reachable under disagg_role='prefill', which the
+        constructor restricts to paged engines."""
+        raise NotImplementedError(
+            "page-blob KV export needs the paged engine")
+
+    def _handoff_fallback(self, request: GenerationRequest,
+                          reason: str) -> None:
+        """A hand-off this pool cannot land (torn content, wrong shape,
+        failed restore) degrades to local recompute — NEVER a failed
+        stream: drop the blobs, release the reservation, and re-park the
+        request; the next admission round prefills its resume window like
+        a replay (PR 3's contract). Loop-thread only (heap access)."""
+        import heapq
+
+        self._abort_admission(request)
+        request.handoff_blobs = None
+        self.handoff_fallbacks_total += 1
+        self._obs.counter("app_tpu_disagg_fallback_total", reason=reason)
+        if self.recorder is not None:
+            self.recorder.record_event(request.id, "disagg_fallback",
+                                       reason=reason)
+        heapq.heappush(self._admission_heap,
+                       (request.priority, request.id, request))
 
     def _drain_pending(self, exc: BaseException) -> None:
         while self._admission_heap:

@@ -60,7 +60,7 @@ class RequestRecord:
     __slots__ = ("id", "prompt_tokens", "max_new_tokens", "priority",
                  "trace_id", "parent_span_id", "enqueued_at", "admitted_at",
                  "first_token_at", "finished_at", "generated", "outcome",
-                 "error", "slot", "bucket", "batch_id", "chunked",
+                 "error", "slot", "bucket", "batch_id", "chunked", "handoff",
                  "events", "events_dropped", "wall0", "mono0")
 
     def __init__(self, request) -> None:
@@ -85,6 +85,14 @@ class RequestRecord:
         self.bucket: Optional[int] = None
         self.batch_id: Optional[int] = None
         self.chunked = False
+        # disaggregated hand-off (tpu/disagg.py): this record covers the
+        # DECODE half of a request whose prefill (and first token) ran on
+        # another engine — the first-token stamp carried over anchors the
+        # decode-side TPOT at hand-off receipt, and span synthesis swaps
+        # queue/prefill for a single engine.handoff span on the same trace
+        self.handoff = bool(getattr(request, "disagg_handoff", False))
+        if self.handoff and getattr(request, "first_token_at", None):
+            self.first_token_at = request.first_token_at
         self.events: List[tuple] = [(self.enqueued_at, "enqueued", None)]
         self.events_dropped = 0
 
@@ -153,6 +161,8 @@ class RequestRecord:
             out["priority"] = self.priority
         if self.chunked:
             out["chunked"] = True
+        if self.handoff:
+            out["handoff"] = True
         ttft = self.ttft_s()
         if ttft is not None:
             out["ttft_s"] = round(ttft, 6)
@@ -377,6 +387,28 @@ class FlightRecorder:
             attrs["tpu.slot"] = rec.slot
         queue_end = (rec.wall(rec.admitted_at)
                      if rec.admitted_at is not None else end)
+        if rec.handoff:
+            # disaggregated decode pool: prefill (and the queue the client
+            # saw) ran on the OTHER engine, whose recorder already emitted
+            # those spans on this same trace id. This record's pre-admit
+            # window is the hop itself — receipt, blob validation, the
+            # H2D landing — so synthesize it as engine.handoff, then the
+            # decode span; an engine.queue/engine.prefill pair here would
+            # double-count phases the request never spent on this pool
+            tracer.span_at("engine.handoff", rec.wall(rec.enqueued_at),
+                           queue_end, trace_id=rec.trace_id,
+                           parent_id=rec.parent_span_id,
+                           attributes=dict(attrs,
+                                           outcome=rec.outcome or ""))
+            if rec.admitted_at is None:
+                return
+            tracer.span_at("engine.decode", rec.wall(rec.admitted_at), end,
+                           trace_id=rec.trace_id,
+                           parent_id=rec.parent_span_id,
+                           attributes=dict(attrs, **{
+                               "tpu.tokens": rec.generated,
+                               "outcome": rec.outcome or ""}))
+            return
         tracer.span_at("engine.queue", rec.wall(rec.enqueued_at), queue_end,
                        trace_id=rec.trace_id, parent_id=rec.parent_span_id,
                        attributes=dict(attrs, outcome=rec.outcome or ""))

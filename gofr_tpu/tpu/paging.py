@@ -249,6 +249,22 @@ class PagedLLMEngine(LLMEngine):
                               min_tokens=min_tokens, top_p=top_p,
                               top_k=top_k, traceparent=traceparent)
 
+    def submit_handoff(self, prompt_tokens, emitted, **kw):
+        """submit()'s never-fits rejection, applied to the hand-off path:
+        a hand-off whose reservation could never fit this pool must be
+        refused at the edge (the coordinator then falls back), not parked
+        forever at the head of its priority class."""
+        total = min(len(prompt_tokens) + kw.get("max_new_tokens", 128),
+                    self.max_seq_len)
+        need = self.allocator.pages_for(total)
+        usable = self.allocator.n_pages - 1
+        if need > usable:
+            raise ValueError(
+                f"hand-off needs {need} pages ({total} tokens at page_size="
+                f"{self.allocator.page_size}) but the pool has only {usable} "
+                f"usable pages; shrink max_new_tokens or grow n_pages")
+        return super().submit_handoff(prompt_tokens, emitted, **kw)
+
     def _request_pages(self, request: GenerationRequest) -> int:
         # resume_tokens + remaining budget == prompt + max_new for fresh
         # requests AND for replays (delivered tokens moved from budget to
@@ -269,7 +285,12 @@ class PagedLLMEngine(LLMEngine):
         step segment (a pool under pressure shows up here, including the
         page-wait retries an exhausted pool causes)."""
         shared: List[int] = []
-        if self.prefix is not None:
+        # hand-off arrivals skip the prefix walk: their KV arrives as page
+        # blobs (landed by _admit_handoff into the plain reservation), so a
+        # prefix match would double-provide the same positions — and on a
+        # fallback the blobs are dropped BEFORE re-parking, so the recompute
+        # pass gets the full prefix/tier treatment like any replay
+        if self.prefix is not None and request.handoff_blobs is None:
             if request.id not in self._prefix_hits:
                 hit = self.prefix.match(request.resume_tokens)
                 if self.kv_tier is not None:
@@ -347,19 +368,26 @@ class PagedLLMEngine(LLMEngine):
             return super()._admission_bucket(request)
         return self._tail_bucket(request, shared)
 
+    def _release_slot_pages(self, slot) -> None:
+        """Return a slot's pages to the allocator (prefix-owned pages stay
+        cache-resident via unref) — shared by the normal finish path and
+        the disaggregated hand-off evacuation."""
+        if slot.pages is None:
+            return
+        if self.prefix is not None:
+            keep = []
+            for page_id in slot.pages:
+                if self.prefix.owns(page_id):
+                    self.prefix.unref(page_id)   # stays cache-resident
+                else:
+                    keep.append(page_id)
+            self.allocator.release(keep)
+        else:
+            self.allocator.release(slot.pages)
+        slot.pages = None
+
     def _finish_slot(self, slot) -> None:
-        if slot.pages is not None:
-            if self.prefix is not None:
-                keep = []
-                for page_id in slot.pages:
-                    if self.prefix.owns(page_id):
-                        self.prefix.unref(page_id)   # stays cache-resident
-                    else:
-                        keep.append(page_id)
-                self.allocator.release(keep)
-            else:
-                self.allocator.release(slot.pages)
-            slot.pages = None
+        self._release_slot_pages(slot)
         super()._finish_slot(slot)
         # pool gauges ride the off-loop finisher: values are READ here on
         # the loop thread (allocator state is loop-owned), flushed off it
@@ -614,11 +642,12 @@ class PagedLLMEngine(LLMEngine):
                     self._prefix_program(
                         tail_b, 1,
                         _pow2_at_least(self.allocator.pages_for(bucket)))
-            if self.kv_tier is not None:
+            if self.kv_tier is not None or self.disagg_role == "decode":
                 # restore widths are organic (however many consecutive
-                # tier hits the walk finds, pow2-padded); warm the small
-                # ones so a conversation's first resume doesn't compile
-                # on the loop thread
+                # tier hits the walk finds — or however many hand-off
+                # pages a wave lands — pow2-padded); warm the small ones
+                # so a conversation's first resume (or the decode pool's
+                # first hand-off) doesn't compile on the loop thread
                 for n in (1, 2):
                     self._restore_program(n)
             # warm the table widths the first admissions will actually hit:
@@ -1316,6 +1345,214 @@ class PagedLLMEngine(LLMEngine):
             slot.pages = list(shared) + fresh
             if self.prefix is not None:
                 self.prefix.insert(request.resume_tokens, slot.pages)
+
+    # -- disaggregated hand-off (tpu/disagg.py) -------------------------------
+    def _handoff_slot(self, slot, request) -> None:
+        """Prefill-pool KV export: gather the slot's prompt pages to the
+        host (the spill path's async-overlap D2H), wrap them as PageBlobs,
+        and give the stream to the hand-off sink; then evacuate the slot
+        WITHOUT a terminal None — the decode pool owns the stream now.
+
+        Runs on the loop thread at prefill sync, right after the first
+        token was emitted (this pool's whole TTFT job). If the sink raises
+        even for a blob-less fallback, the slot stays bound and decode
+        continues locally, colocated-style — degraded, never dropped."""
+        if self._handoff_sink is None:
+            # bare prefill-role engine with no worker wired (tests): keep
+            # the slot; decode runs locally
+            return
+        import time as _time
+
+        from .kvtier import PageBlob
+
+        ps = self.page_size
+        n_ctx = slot.length          # positions whose KV the pages hold:
+        window = request.resume_tokens[:n_ctx]   # the bound resume window
+        n_kv = self.allocator.pages_for(n_ctx)
+        handled, delivered = True, False
+        try:
+            with self.steps.seg("kv_handoff"):
+                ids = np.asarray(slot.pages[:n_kv], dtype=np.int32)
+                pulls = [self.k_cache[:, ids], self.v_cache[:, ids]]
+                if self._q8:
+                    pulls += [self.k_scale[:, ids], self.v_scale[:, ids]]
+                host = self._fetch_host(*pulls)
+                k, v = host[0], host[1]
+                ks, vs = (host[2], host[3]) if self._q8 else (None, None)
+                blobs = []
+                for i in range(n_kv):
+                    # tokens carry only the covered positions (the last
+                    # page is usually partial): the decode pool's content
+                    # verify reconcatenates them against its resume window
+                    blobs.append(PageBlob(
+                        tuple(window[i * ps:(i + 1) * ps]),
+                        k[:, i], v[:, i],
+                        None if ks is None else ks[:, i],
+                        None if vs is None else vs[:, i]))
+                delivered = bool(self._handoff_sink(request, blobs, n_ctx))
+        except Exception:  # noqa: BLE001 - losing the export must not lose
+            # the stream: offer the sink a blob-less hand-off (decode-pool
+            # recompute of the resume window)
+            try:
+                delivered = bool(self._handoff_sink(request, None, n_ctx))
+            except Exception:  # noqa: BLE001
+                handled = False
+        if not handled:
+            return  # slot stays bound: local decode is the last resort
+        if delivered:
+            self.handoffs_total += 1
+            self._obs.counter("app_tpu_disagg_handoffs_total")
+        else:
+            # the sink took ownership but already arranged its own
+            # fallback (bounded queue full, decode pool shedding, ...)
+            self.handoff_fallbacks_total += 1
+            self._obs.counter("app_tpu_disagg_fallback_total",
+                              reason="export")
+        # evacuate exactly like _finish_slot, minus the terminal None
+        self._release_slot_pages(slot)
+        slot.request = None
+        slot.length = 0
+        slot.remaining = 0
+        slot.history = None
+        if self.sampling_controls and (request.top_p or request.top_k):
+            idx = next((i for i, s in enumerate(self.slots) if s is slot),
+                       None)
+            if idx is not None:
+                self._temps = self._temps.at[idx].set(0.0)
+        request.finished_at = _time.monotonic()
+        active_now = sum(1 for s in self.slots if s.active)
+        used, free = self.allocator.used_pages, self.allocator.free_pages
+
+        def job() -> None:
+            if request.gen_span is not None:
+                request.gen_span.set_attribute("tpu.tokens",
+                                               request.generated)
+                request.gen_span.set_attribute("disagg.handoff", True)
+                request.gen_span.end()
+            if self.recorder is not None:
+                self.recorder.record_finished(request, "handoff")
+            self._obs.gauge("app_tpu_active_slots", active_now)
+            self._obs.gauge("app_tpu_pages_used", used)
+            self._obs.gauge("app_tpu_kv_pool_pages", used, kind="used")
+            self._obs.gauge("app_tpu_kv_pool_pages", free, kind="free")
+
+        self._run_off_loop(job)
+
+    def _admit_handoff(self, batch, free_iter, dispatched) -> None:
+        """Decode-pool hand-off admission: validate each request's blobs
+        against THIS pool (shape/dtype/scale presence plus token-content
+        verify), land the whole wave's pages in one donated H2D scatter,
+        and splice loop state so the next decode block simply continues
+        the stream — no prefill dispatch, ever, on this pool. Any blob
+        that fails verification degrades that request to a re-parked
+        recompute (_handoff_fallback), mirroring the tier-restore guard
+        in _restore_from_tier."""
+        import time as _time
+
+        jnp = self._jnp
+        ps = self.page_size
+        L, _, Hkv, dh, _ = self.k_cache.shape
+        pool_dt = np.dtype(self.k_cache.dtype)
+        ready = []
+        with self.steps.seg("kv_handoff"):
+            for request in batch:
+                blobs = request.handoff_blobs
+                # KV covers the resume window MINUS the last emitted token
+                # (its KV is written by this pool's first decode step) —
+                # the exact state a colocated slot has post-prefill-emit
+                window = request.resume_tokens[:-1]
+                n_ctx = len(window)
+                reason = None
+                if len(blobs) != self.allocator.pages_for(n_ctx):
+                    reason = "page_count"
+                else:
+                    covered = []
+                    for blob in blobs:
+                        if (blob.k.shape != (L, Hkv, dh, ps)
+                                or blob.k.dtype != pool_dt
+                                or (self._q8 and blob.k_scale is None)):
+                            reason = "shape"
+                            break
+                        covered.extend(blob.tokens)
+                    if reason is None and covered != list(window):
+                        reason = "content"
+                if reason is not None:
+                    self._handoff_fallback(request, reason)
+                    dispatched.add(request.id)  # parked, not failed: the
+                    continue  # caller's except-cleanup must skip it
+                ready.append(request)
+            if not ready:
+                return
+            # one pow2-padded donated scatter lands the whole wave; blobs
+            # restore into the HEAD of each reservation (decode growth
+            # continues into the tail pages)
+            pages_all, blobs_all = [], []
+            for request in ready:
+                n_kv = len(request.handoff_blobs)
+                pages_all.extend(self._reservations[request.id][:n_kv])
+                blobs_all.extend(request.handoff_blobs)
+            try:
+                self._h2d_restore(pages_all, blobs_all)
+            except Exception:  # noqa: BLE001 - restore is recoverable by
+                # recompute; a real device loss resurfaces at dispatch
+                for request in ready:
+                    self._handoff_fallback(request, "restore")
+                    dispatched.add(request.id)
+                return
+        with self.steps.seg("host_prep"):
+            if self.sampling_controls:
+                from .sampling import pack_controls
+
+                new_temps = pack_controls([r.temperature for r in ready],
+                                          [r.top_p for r in ready],
+                                          [r.top_k for r in ready])
+            else:
+                new_temps = np.asarray([r.temperature for r in ready],
+                                       dtype=np.float32)
+            batch_id = next(self._batch_seq)
+            now = _time.monotonic()
+            idxs, last_toks, lengths = [], [], []
+            for request in ready:
+                slot_idx = next(free_iter)
+                slot = self.slots[slot_idx]
+                n_kv = len(request.handoff_blobs)
+                slot.request = request
+                slot.length = len(request.resume_tokens) - 1
+                # budget counts EMISSIONS and the prefill pool's emissions
+                # already moved into `generated` (no -1: nothing emits at
+                # this bind — compare _bind_slots, whose -1 pre-pays the
+                # prefill sync's first token)
+                slot.remaining = request.max_new_tokens - request.generated
+                slot.pages = self._reservations.pop(request.id)
+                slot.history = (list(request.resume_tokens)
+                                if self.speculative_tokens else None)
+                request.handoff_blobs = None   # free the host copies
+                request.admitted_at = now
+                self._obs.hist("app_tpu_queue_wait_seconds",
+                               now - request.enqueued_at)
+                idxs.append(slot_idx)
+                last_toks.append(request.resume_tokens[-1])
+                lengths.append(slot.length)
+                for span in (request.span, request.gen_span):
+                    if span is not None:
+                        span.set_attribute("batch.id", batch_id)
+                        span.set_attribute("tpu.slot", slot_idx)
+                if self.recorder is not None:
+                    self.recorder.record_admitted(request, slot_idx, 0,
+                                                  batch_id=batch_id)
+                    self.recorder.record_event(request.id, "kv_handoff",
+                                               pages=n_kv)
+                dispatched.add(request.id)
+        # splice loop state (eager scatters, off the decode hot loop): the
+        # next decode block feeds each slot its last emitted token at the
+        # position right after its restored KV — identical device state to
+        # a colocated slot that just emitted its first token
+        sl = jnp.asarray(np.asarray(idxs, dtype=np.int32))
+        self._tokens = self._tokens.at[sl].set(
+            jnp.asarray(np.asarray(last_toks, dtype=np.int32)))
+        self._positions = self._positions.at[sl].set(
+            jnp.asarray(np.asarray(lengths, dtype=np.int32)))
+        self._temps = self._temps.at[sl].set(jnp.asarray(new_temps))
 
     # -- dispatch -------------------------------------------------------------
     def _build_table(self) -> np.ndarray:

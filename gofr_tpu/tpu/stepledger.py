@@ -30,6 +30,13 @@ explicit ``other`` residual means nothing can hide):
                    separately from ``page_alloc`` (nested segments
                    subtract child time) so "restore is slower than
                    recompute" is attributable from the ledger alone
+  ``kv_handoff``   disaggregated serving (tpu/disagg.py): on the prefill
+                   pool, the D2H page gather + PageBlob encode that ships
+                   a finished prompt's KV to the decode pool; on the
+                   decode pool, blob validation + the donated H2D scatter
+                   that lands handed-off KV before a slot binds. Charged
+                   separately from ``kv_restore`` so tier restores and
+                   hand-off restores stay distinguishable in the ledger
   ``host_prep``    batch array prep: padding, lengths, sampling controls,
                    block tables
   ``compile``      executor cache-miss compiles, re-attributed out of
@@ -85,8 +92,9 @@ from typing import Any, Dict, List, Optional
 
 from .obs import MetricsHook
 
-SEGMENTS = ("admission", "page_alloc", "kv_restore", "host_prep", "compile",
-            "cache_grow", "dispatch", "device_sync", "demux", "emit", "other")
+SEGMENTS = ("admission", "page_alloc", "kv_restore", "kv_handoff",
+            "host_prep", "compile", "cache_grow", "dispatch", "device_sync",
+            "demux", "emit", "other")
 
 # step phases, by what the iteration synced (one sync per iteration) or,
 # sync-less, what it dispatched

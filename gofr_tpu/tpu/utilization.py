@@ -170,6 +170,11 @@ class UtilizationLedger:
                             else time.monotonic())
         self._obs = MetricsHook(metrics)
         self.dispatches_total = 0
+        # disaggregated serving (tpu/disagg.py): when this ledger belongs
+        # to one pool of a prefill/decode split, `pool` tags a per-pool
+        # duty-cycle gauge so both halves are comparable side by side
+        # (the un-labelled duty cycle would otherwise collapse them)
+        self.pool = ""
 
     # -- wiring ---------------------------------------------------------------
     def use_metrics(self, metrics) -> None:
@@ -294,6 +299,9 @@ class UtilizationLedger:
         an idle engine decays toward zero instead of freezing stale)."""
         stats = self.window_stats(now=now)
         self._obs.gauge("app_tpu_device_duty_cycle", stats["duty_cycle"])
+        if self.pool:
+            self._obs.gauge("app_tpu_disagg_pool_duty_cycle",
+                            stats["duty_cycle"], pool=self.pool)
         self._obs.gauge("app_tpu_host_overhead_seconds",
                         stats["host_overhead_s"])
         for phase in ("prefill", "decode"):

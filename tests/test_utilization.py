@@ -310,8 +310,13 @@ def test_metric_inventory_consistency():
     # paging.py's spill/restore recording style)
     assert any(n.startswith("app_tpu_kv_tier_") for n in recorded), \
         "kv tier counters vanished from the inventory scan"
+    # the disaggregation family must be IN the scan (guards regex rot
+    # against disagg.py's hand-off recording style)
+    assert any(n.startswith("app_tpu_disagg_") for n in recorded), \
+        "disagg hand-off counters vanished from the inventory scan"
 
     from gofr_tpu.tpu.device import TPUClient
+    from gofr_tpu.tpu.disagg import register_disagg_metrics
     from gofr_tpu.tpu.flightrecorder import register_slo_gauges
     from gofr_tpu.tpu.stepledger import register_step_metrics
 
@@ -322,6 +327,7 @@ def test_metric_inventory_consistency():
     register_slo_gauges(manager)
     register_utilization_metrics(manager)
     register_step_metrics(manager)  # idempotent next to register_metrics
+    register_disagg_metrics(manager)
     registered = set(manager._store)
     missing = recorded - registered
     assert not missing, (
@@ -361,7 +367,7 @@ def test_debug_endpoint_inventory_documented():
     # regex-rot guard: the known surfaces must all be in the scan
     for expected in ("/debug/profile", "/debug/requests", "/debug/engine",
                      "/debug/steps", "/debug/faults", "/debug/slo",
-                     "/debug/incidents"):
+                     "/debug/incidents", "/debug/disagg"):
         assert expected in routes, f"scan missed {expected} (regex rot?)"
 
     docs = os.path.join(os.path.dirname(__file__), "..", "docs",

@@ -13,8 +13,10 @@ page pool, utilization window — MFU/MBU/duty-cycle — and compile-cache
 totals), the `/debug/steps` anatomy summary (per-phase step-time
 baselines, segment totals, recent stragglers), the `/debug/slo`
 burn-rate readout (per-SLO fast/slow burn + alert state — the paging
-signal), and the `/debug/incidents` index (auto-captured evidence
-bundles + suppression counts), so soak artifacts gain efficiency,
+signal), the `/debug/incidents` index (auto-captured evidence
+bundles + suppression counts), and — on split-serving deployments
+(DISAGG_MODE=both) — the `/debug/disagg` hand-off counters (queue
+depth, hand-offs, fallbacks), so soak artifacts gain efficiency,
 step-anatomy, and error-budget axes next to the tail evidence.
 
 Usage:
@@ -137,6 +139,17 @@ def poll_once(server: str, metrics_base: str) -> dict:
         }
     except Exception as exc:  # noqa: BLE001 - older servers lack the route
         entry["incidents_error"] = str(exc)
+    try:
+        body = json.loads(_get(server.rstrip("/") + "/debug/disagg"))
+        snap = body.get("data", body)
+        # counters + depths only; the nested per-pool engine snapshots
+        # would duplicate /debug/engine in every line
+        entry["disagg"] = {k: snap.get(k) for k in (
+            "worker_alive", "queue_depth", "pending_handoffs",
+            "handoffs_in_flight", "handoffs_total", "handoffs_consumed",
+            "fallbacks_total")}
+    except Exception as exc:  # noqa: BLE001 - colocated servers lack the route
+        entry["disagg_error"] = str(exc)
     try:
         entry["gauges"] = scrape_gauges(metrics_base)
     except Exception as exc:  # noqa: BLE001

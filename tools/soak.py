@@ -439,11 +439,105 @@ def run_multihost(seconds: float) -> bool:
     return ok
 
 
+def run_disagg(seconds: float, n_threads: int, preset: str) -> bool:
+    """Split-pair soak (tpu/disagg.py): the full mixed-traffic worker mix
+    (prompt-heavy shared-prefix bursts + decode-heavy repetitive prompts)
+    drives the DisaggRouter front door, and a timer chaos-kills the
+    prefill worker mid-run. Pass = ZERO failed requests — the kill may
+    surface only as fallback counters (decode pool recomputes from
+    prompt + emitted, PR 3's replay contract) — plus a drained decode
+    pool with zero leaked pages and ZERO prefill steps in its ledger
+    (the disaggregation invariant the whole split exists to buy)."""
+    from gofr_tpu.models.llama import LlamaConfig, llama_init
+    from gofr_tpu.tpu.disagg import DisaggRouter
+    from gofr_tpu.tpu.flightrecorder import FlightRecorder
+    from gofr_tpu.tpu.paging import PagedLLMEngine
+
+    cfg = {"debug": LlamaConfig.debug, "llama1b": LlamaConfig.llama1b}[preset]()
+    small = preset == "debug"
+    kw = dict(
+        max_seq_len=256 if small else 1024,
+        prefill_buckets=(16, 32, 64) if small else (64, 128, 256, 512),
+        decode_block_size=4 if small else 16,
+        page_size=16 if small else 128,
+    )
+    params = llama_init(cfg, seed=0)  # shared weights: single-host split
+    pre = PagedLLMEngine(params, cfg, disagg_role="prefill",
+                         n_slots=4 if small else 16, **kw)
+    dec = PagedLLMEngine(params, cfg, disagg_role="decode",
+                         n_slots=8 if small else 64, **kw)
+    dec.recorder = recorder = FlightRecorder(capacity=512)
+    router = DisaggRouter(pre, dec)
+    pre.start()
+    dec.start()
+    router.start()
+    pre.warmup()
+    dec.warmup()
+    # kill the prefill worker mid-run: early enough that plenty of
+    # traffic lands on the degraded path, late enough that the healthy
+    # hand-off path soaked first. The decode-pool ledger is snapshotted
+    # AT the kill: before it, prefill steps there mean the split leaked
+    # work (gated to zero); after it, they ARE the degraded recompute
+    # path doing its job (recorded, not gated)
+    kill_at = max(1.0, seconds / 2.0)
+    at_kill = {}
+
+    def _chaos_kill():
+        snap = dec.steps.snapshot(recent=0)
+        at_kill["decode_pool_prefill_steps"] = int(
+            snap["summary"].get("prefill", {}).get("steps", 0))
+        router.worker.kill()
+
+    killer = threading.Timer(kill_at, _chaos_kill)
+    killer.daemon = True
+    killer.start()
+    t0 = time.time()
+    stats = {"profile": "disagg", "preset": preset, "kill_at_s": kill_at}
+    try:
+        stats.update(_soak(router, seconds, n_threads, cfg.vocab_size))
+        drained = dec.drain(timeout_s=120)
+    finally:
+        killer.cancel()
+        router.stop()
+        if router.worker.alive:
+            # short run where the timer never fired: normal teardown
+            pre.drain(timeout_s=120)
+            pre.stop()
+        dec.stop()
+    stats["seconds"] = round(time.time() - t0, 1)
+    stats["drained"] = drained
+    stats["worker_killed"] = not router.worker.alive
+    stats["handoffs_total"] = pre.handoffs_total
+    stats["handoffs_consumed"] = router.coordinator.consumed_total
+    stats["fallbacks_total"] = (router.fallbacks_total
+                                + pre.handoff_fallbacks_total
+                                + dec.handoff_fallbacks_total)
+    step_snap = dec.steps.snapshot(recent=0)
+    total_prefills = int(
+        step_snap["summary"].get("prefill", {}).get("steps", 0))
+    healthy_prefills = at_kill.get("decode_pool_prefill_steps", 0)
+    stats["decode_pool_prefill_steps_healthy"] = healthy_prefills
+    stats["decode_pool_recompute_prefill_steps"] = (total_prefills
+                                                    - healthy_prefills)
+    stats["decode_pool_leaked_pages"] = dec.allocator.used_pages
+    stats["engine_events"] = [
+        {"event": e.get("event"), "t": round(e.get("t", 0.0), 2)}
+        for e in recorder.snapshot()["engine_events"]][:24]
+    ok = (stats["errors"] == 0 and drained and stats["ok"] > 0
+          and stats["worker_killed"]
+          and stats["handoffs_total"] > 0
+          and healthy_prefills == 0
+          and dec.allocator.used_pages == 0)
+    stats["pass"] = ok
+    print(json.dumps(stats))
+    return ok
+
+
 def main() -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("profile", nargs="?", default="all",
                         choices=["mixed", "paged-int8", "spec", "chat",
-                                 "multihost", "all"])
+                                 "disagg", "multihost", "all"])
     parser.add_argument("--seconds", type=float, default=120.0)
     parser.add_argument("--threads", type=int, default=4)
     parser.add_argument("--chaos", action="store_true",
@@ -459,11 +553,14 @@ def main() -> int:
         jax.config.update("jax_platforms", platform)
     preset = os.environ.get("SOAK_PRESET", "debug")
 
-    profiles = (["mixed", "paged-int8", "spec", "chat", "multihost"]
+    profiles = (["mixed", "paged-int8", "spec", "chat", "disagg",
+                 "multihost"]
                 if args.profile == "all" else [args.profile])
     results = []
     for p in profiles:
-        if p == "multihost":
+        if p == "disagg":
+            results.append(run_disagg(args.seconds, args.threads, preset))
+        elif p == "multihost":
             # under `all`, cap the two-process tier so it doesn't dominate
             # the sequence's wall time (the plane's invariants saturate
             # within ~30 s); an explicit `multihost` run honors --seconds
