@@ -488,6 +488,110 @@ def run_phase_http(engine, n_streams, max_new, prompt_chars, rng):
             "http_streams": len(ok), "http_errors": len(errors)}
 
 
+def run_phase_fleet(sessions=6, turns=4, max_tokens=8):
+    """Fleet front door (gofr_tpu/fleet): warm-turn TTFT with
+    prefix-affinity routing vs round-robin over 2 debug-preset replicas.
+
+    Session-heavy traffic: each session re-sends its growing history
+    every turn, so turn N's prompt is a strict prefix-extension of turn
+    N-1's. Affinity pins a session to the replica whose paged prefix
+    cache already holds those pages; round-robin alternates replicas on
+    every request, so a session's consecutive turns land on a replica
+    that must re-prefill the whole history cold. Warm turns only (each
+    session's first turn prefills cold everywhere and is excluded).
+    Both arms run through the REAL examples/router app against the SAME
+    replica pair; each arm uses fresh session texts so arm two cannot
+    ride arm one's cached prefixes. Returns {fleet_ttft_rr_ms,
+    fleet_ttft_affinity_ms, fleet_affinity_ttft_win_ms,
+    fleet_affinity_hit_rate}."""
+    import random
+    import urllib.request
+
+    from gofr_tpu.config import MockConfig
+
+    llm = _load_example("llm-server")
+    router_mod = _load_example("router")
+    replicas = []
+    for i in range(2):
+        app = llm.build_app(config=MockConfig({
+            "HTTP_PORT": "0", "METRICS_PORT": "0", "GRPC_PORT": "0",
+            "APP_NAME": f"bench-replica{i}", "MODEL_PRESET": "debug",
+            "PAGED": "true", "PAGE_SIZE": "16", "PREFIX_CACHE": "true",
+            "MAX_SEQ_LEN": "512", "MAX_BATCH": "4", "WARMUP": "true",
+            "REQUEST_TIMEOUT": "120", "LOG_LEVEL": "ERROR",
+            "INCIDENT_AUTOPSY": "false"}))
+        app.start()
+        replicas.append(app)
+
+    def _ttft(base, prompt):
+        """Client clock start → first SSE data event through the router."""
+        req = urllib.request.Request(
+            base + "/generate",
+            data=json.dumps({"prompt": prompt, "stream": True,
+                             "max_tokens": max_tokens}).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        t0 = time.monotonic()
+        first = None
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            for line in resp:
+                if line.startswith(b"data: "):
+                    if first is None:
+                        first = time.monotonic()
+                    if json.loads(line[6:].strip()).get("done"):
+                        break
+        if first is None:
+            raise RuntimeError("stream ended before any token")
+        return (first - t0) * 1e3
+
+    def _arm(policy, seed):
+        router_app = router_mod.build_app(config=MockConfig({
+            "HTTP_PORT": "0", "METRICS_PORT": "0",
+            "APP_NAME": f"bench-router-{policy}",
+            "REQUEST_TIMEOUT": "120", "LOG_LEVEL": "ERROR",
+            "FLEET_REPLICAS": ",".join(
+                f"r{i}=http://127.0.0.1:{a.http_port}"
+                for i, a in enumerate(replicas)),
+            "FLEET_POLICY": policy, "FLEET_PROBE_S": "0.5",
+            "FLEET_AFFINITY_BLOCK": "24", "FLEET_RETRY_BUDGET": "2"}))
+        router_app.start()
+        base = f"http://127.0.0.1:{router_app.http_port}"
+        rng = random.Random(seed)
+        alphabet = "abcdefghijklmnopqrstuvwxyz "
+        warm_ttfts = []
+        try:
+            for s in range(sessions):
+                # debug replicas admit ~255 prompt tokens; the byte-ish
+                # tokenizer makes chars ≈ tokens, so size the trunk +
+                # growth to stay under the limit on the last turn
+                history = (f"{policy} session {s:02d}: " + "".join(
+                    rng.choice(alphabet) for _ in range(100)))
+                for t in range(turns):
+                    ms = _ttft(base, history)
+                    if t > 0:  # first turn prefills cold everywhere
+                        warm_ttfts.append(ms)
+                    history += f" turn{t} " + "".join(
+                        rng.choice(alphabet) for _ in range(24))
+            body = json.loads(urllib.request.urlopen(
+                base + "/debug/fleet", timeout=10).read())
+            snap = body.get("data", body)
+            hit_rate = (snap.get("affinity") or {}).get("hit_rate")
+        finally:
+            router_app.shutdown()
+        warm_ttfts.sort()
+        return warm_ttfts[len(warm_ttfts) // 2], hit_rate
+
+    try:
+        rr_ms, _ = _arm("round_robin", seed=7001)
+        aff_ms, hit_rate = _arm("affinity", seed=7002)
+    finally:
+        for app in replicas:
+            app.shutdown()
+    return {"fleet_ttft_rr_ms": round(rr_ms, 2),
+            "fleet_ttft_affinity_ms": round(aff_ms, 2),
+            "fleet_affinity_ttft_win_ms": round(rr_ms - aff_ms, 2),
+            "fleet_affinity_hit_rate": hit_rate}
+
+
 class _Record:
     """Cumulative result emitter: every update() reprints the full JSON line,
     so a crash after phase N still leaves phase N's line as the last parsable
@@ -1430,6 +1534,29 @@ def main() -> None:
         except Exception:  # noqa: BLE001
             pass
         engine = None
+
+    # ---- FL: fleet router — affinity vs round-robin TTFT (labeled extra) --
+    # After T3 on purpose: the headline engines are stopped, so the two
+    # debug-preset replica boots cannot starve or OOM the north-star
+    # phases. Measures what the router tier buys: warm session turns
+    # landing on the replica that already holds the prefix pages.
+    try:
+        if full_run and _left() > 180 and not _WEDGED:
+            fl = run_phase_fleet()
+            print(f"[bench] FL fleet: round-robin warm TTFT "
+                  f"{fl['fleet_ttft_rr_ms']:.1f}ms vs affinity "
+                  f"{fl['fleet_ttft_affinity_ms']:.1f}ms "
+                  f"(hit rate {fl['fleet_affinity_hit_rate']}) "
+                  f"t={_spent():.0f}s", file=sys.stderr)
+            record.update(**fl)
+        elif full_run:
+            record.update(fleet_skipped=("device wedged" if _WEDGED
+                                         else "budget"))
+    except Exception as exc:  # noqa: BLE001 - keep earlier phases' record
+        print(f"[bench] FL phase failed (earlier results preserved): "
+              f"{exc}", file=sys.stderr)
+        record.update(fleet_error=f"{type(exc).__name__}: {exc}"[:200])
+        _note_wedge(exc, record, "FL")
 
     # ---- M2: BERT /embed over gRPC (BASELINE config 3, labeled extra) -----
     # Last on purpose: every LLM engine is stopped, so its HBM is free, and

@@ -460,6 +460,17 @@ def build_app(config=None, engine=None) -> App:
     # token streaming over gRPC rides the same engine (GRPC_PORT)
     app.register_grpc_service(build_generate_service(submitter, tokenizer))
 
+    # fleet advertisement: routers (gofr_tpu/fleet) probe /stats every
+    # FLEET_PROBE_S for load + a bounded digest of served prefix keys —
+    # the digest re-warms a restarted router's affinity map, and its
+    # per-boot generation id tells routers when THIS replica restarted
+    # (KV gone, learned affinity stale)
+    from gofr_tpu.fleet.affinity import AffinityRecorder
+
+    affinity = AffinityRecorder(
+        block=app.config.get_int("FLEET_AFFINITY_BLOCK", 256))
+    app.fleet_affinity = affinity
+
     @app.post("/generate")
     def generate(ctx):
         body = ctx.bind()
@@ -494,6 +505,7 @@ def build_app(config=None, engine=None) -> App:
             raise InvalidParam([str(exc)]) from exc
         except Exception as exc:  # noqa: BLE001 - sheds → 503 + Retry-After
             _raise_for_shed(exc)
+        affinity.record(prompt)  # admitted: its prefix now lives here
 
         if not stream:
             from gofr_tpu.http.errors import RequestTimeout
@@ -556,6 +568,13 @@ def build_app(config=None, engine=None) -> App:
         recorder = getattr(engine, "recorder", None)
         if recorder is not None:
             out["slo"] = recorder.slo_stats()
+        # cheap fleet probe payload: O(k) affinity digest + duty cycle,
+        # NOT the full /debug/engine page-pool dump
+        fleet = {"affinity": affinity.digest()}
+        util = getattr(engine, "util", None)
+        if util is not None:
+            fleet["duty_cycle"] = util.window_stats()["duty_cycle"]
+        out["fleet"] = fleet
         return out
 
     return app

@@ -1,0 +1,58 @@
+"""Fleet front door: prefix- and health-aware router over N llm-server
+replicas.
+
+Run N replicas (each an `examples/llm-server` process), then point this
+router at them:
+
+    FLEET_REPLICAS=r0=http://host0:8000,r1=http://host1:8000 \
+    HTTP_PORT=9000 REQUEST_TIMEOUT=120 python examples/router/main.py
+
+Clients POST /generate here exactly as they would to a single replica —
+SSE token streams pass through byte-for-byte and one trace spans
+router -> replica.  `GET /debug/fleet` shows the replica table (health,
+breaker, queue depth, in-flight, affinity hit rate); metrics land in the
+`app_tpu_fleet_*` family on METRICS_PORT.
+
+Config (see docs/configs.md for the full table):
+  FLEET_REPLICAS        comma-separated name=url or bare urls (required)
+  FLEET_POLICY          affinity | p2c | round_robin   (default affinity)
+  FLEET_AFFINITY_BLOCK  chars per affinity hash block  (default 256)
+  FLEET_PROBE_S         health/stats probe period      (default 2.0)
+  FLEET_RETRY_BUDGET    max re-attempts of UNSTARTED requests (default 2)
+
+NOTE: raise REQUEST_TIMEOUT on the router — non-streaming /generate
+holds the handler until the replica finishes generating.
+"""
+
+import os
+
+from gofr_tpu import App
+from gofr_tpu.fleet import FleetRouter, install_routes, register_fleet_metrics
+
+
+def build_app(config=None) -> App:
+    """App + fleet router, reusable by tests / soak / bench (the measured
+    path is the real handler + pass-through stream).  The router rides on
+    `app.fleet`."""
+    app = App(config=config)
+    register_fleet_metrics(app.container.metrics_manager)
+    router = FleetRouter.from_config(app.config, logger=app.logger,
+                                     metrics=app.container.metrics_manager)
+    app.fleet = router
+    # the router's own /.well-known/health reports DOWN when no replica
+    # is routable, DEGRADED while any is ejected — upstream LBs can use
+    # the same signal clients of a single replica already understand
+    app.container.add_health_contributor("fleet", router.health_check)
+    install_routes(app, router)
+    router.start()
+    app.on_shutdown(router.stop)
+    return app
+
+
+def main() -> None:
+    os.chdir(os.path.dirname(os.path.abspath(__file__)))
+    build_app().run()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,43 @@
+"""Fleet front door: a prefix- and health-aware router tier over N
+llm-server replicas.
+
+Everything below `gofr_tpu/tpu/` serves from ONE process; this package is
+the horizontal unlock (ROADMAP item 1): a router process that fronts N
+replicas with
+
+  - a replica registry driven by the replicas' existing health surfaces
+    (`/.well-known/health` aggregate + `/stats` load/affinity signals),
+    each backend wrapped in the GoFr outbound `service` client's
+    CircuitBreaker so a dead replica is ejected and probed back in
+    (PAPER.md's circuit-breaker layer, finally used for serving);
+  - prefix-affinity routing: the prompt's leading char blocks hash to
+    stable keys, a router-side map remembers which replica's KV already
+    holds that prefix (learned from routed responses, re-warmed from the
+    bounded digests each replica advertises), so multi-turn sessions and
+    shared system prompts land where their pages live;
+  - load spillover: queue-depth/duty-cycle snapshots break affinity when
+    the preferred replica is saturated, with power-of-two-choices as the
+    default spill/miss policy (`affinity` | `p2c` | `round_robin`);
+  - transparent streaming: SSE/chunked bodies pass through byte-for-byte,
+    traceparent propagates so one trace spans router -> replica, and only
+    UNSTARTED requests (connect failure / 503 shed) are retried — a
+    stream that has emitted tokens is never re-sent.
+
+Operator surface: `GET /debug/fleet` + the `app_tpu_fleet_*` metric
+family. `examples/router` is the runnable front door; docs/fleet.md has
+the failure matrix.
+"""
+
+from .affinity import AffinityMap, AffinityRecorder, affinity_keys
+from .debug import register_fleet_metrics
+from .policy import (AffinityPolicy, P2CPolicy, RoundRobinPolicy,
+                     RoutingPolicy, make_policy)
+from .proxy import FleetRouter, install_routes
+from .registry import FleetRegistry, Replica
+
+__all__ = [
+    "AffinityMap", "AffinityRecorder", "affinity_keys",
+    "AffinityPolicy", "P2CPolicy", "RoundRobinPolicy", "RoutingPolicy",
+    "make_policy", "FleetRouter", "install_routes", "FleetRegistry",
+    "Replica", "register_fleet_metrics",
+]

@@ -28,9 +28,41 @@ class ServiceResponse:
         self.status_code = status_code
         self.body = body
         self.headers = headers or {}
+        self.raw = None  # underlying requests.Response when stream=True
 
     def json(self) -> Any:
         return json.loads(self.body.decode("utf-8")) if self.body else None
+
+    def header(self, name: str) -> Optional[str]:
+        lowered = name.lower()
+        for key, value in self.headers.items():
+            if key.lower() == lowered:
+                return value
+        return None
+
+    def iter_chunks(self, chunk_size: Optional[int] = None):
+        """Yield body bytes as they arrive (stream=True), or the buffered
+        body in one piece otherwise."""
+        if self.raw is None:
+            if self.body:
+                yield self.body
+            return
+        yield from self.raw.iter_content(chunk_size=chunk_size)
+
+    def read(self) -> bytes:
+        """Drain a streamed response into `body` (no-op when buffered)."""
+        if self.raw is not None:
+            self.body = self.raw.content
+            self.raw = None
+        return self.body
+
+    def close(self) -> None:
+        if self.raw is not None:
+            try:
+                self.raw.close()
+            except Exception:  # noqa: BLE001 - close is best-effort
+                pass
+            self.raw = None
 
 
 class CircuitOpenError(Exception):
@@ -68,7 +100,14 @@ class HTTPService:
         return self.request(ctx, "DELETE", path, body=body, headers=headers)
 
     def request(self, ctx, method: str, path: str, params=None, body=None,
-                headers=None) -> ServiceResponse:
+                headers=None, stream: bool = False,
+                timeout_s: Optional[float] = None) -> ServiceResponse:
+        """One outbound call.  With ``stream=True`` the body is NOT
+        buffered: the ServiceResponse carries the live connection in
+        ``.raw`` (iterate with ``iter_chunks``, finish with ``close``)
+        and the span/histogram cover time-to-headers only — a router
+        proxying an hour-long SSE stream must not hold a span open or
+        skew the latency histogram for the duration."""
         import requests
 
         url = f"{self.address}/{path.lstrip('/')}"
@@ -94,8 +133,11 @@ class HTTPService:
         start = time.time()
         try:
             resp = requests.request(method, url, params=params, data=data,
-                                    headers=allheaders, timeout=self.timeout_s)
-            status, content = resp.status_code, resp.content
+                                    headers=allheaders,
+                                    timeout=timeout_s or self.timeout_s,
+                                    stream=stream)
+            status = resp.status_code
+            content = b"" if stream else resp.content
             resp_headers = dict(resp.headers)
         finally:
             elapsed = time.time() - start
@@ -107,7 +149,10 @@ class HTTPService:
             if self.logger is not None:
                 self.logger.debugf("http service %s %s took %dµs", method, url,
                                    int(elapsed * 1e6))
-        return ServiceResponse(status, content, resp_headers)
+        out = ServiceResponse(status, content, resp_headers)
+        if stream:
+            out.raw = resp
+        return out
 
     def health_check(self) -> Health:
         try:
@@ -188,12 +233,14 @@ class OAuthConfig(Options):
     def apply(self, svc: HTTPService) -> HTTPService:
         original = svc.request
 
-        def with_token(ctx, method, path, params=None, body=None, headers=None):
+        def with_token(ctx, method, path, params=None, body=None, headers=None,
+                       **kwargs):
             token = self._fetch()
             headers = dict(headers or {})
             if token:
                 headers["Authorization"] = f"Bearer {token}"
-            return original(ctx, method, path, params=params, body=body, headers=headers)
+            return original(ctx, method, path, params=params, body=body,
+                            headers=headers, **kwargs)
 
         svc.request = with_token  # type: ignore[method-assign]
         return svc

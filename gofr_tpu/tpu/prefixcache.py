@@ -73,6 +73,13 @@ class PrefixCache:
             "evicted_pages": self.evicted_pages,
         }
 
+    def digest(self, k: int = 16) -> List[str]:
+        """Bounded O(k) list of the hottest (MRU-end) chain-key hashes,
+        hex-encoded.  The cheap probe payload for fleet routers — never
+        the full entry table, which is O(pool)."""
+        hot = list(self._entries)[-max(0, k):]
+        return [format(key & 0xFFFFFFFFFFFFFFFF, "016x") for key in hot]
+
     # -- key construction ----------------------------------------------------
     def _keys_for(self, tokens: Sequence[int], n_pages: int) -> List[int]:
         """Cumulative chain keys for the first n_pages full pages."""

@@ -499,6 +499,9 @@ def engine_snapshot(engine, tpu=None) -> Dict[str, Any]:
         if prefix is not None:
             try:
                 out["page_pool"]["prefix_cache"] = prefix.stats()
+                # bounded hot-chain-key digest so fleet routers polling
+                # this surface never pay O(pool) serialization
+                out["page_pool"]["prefix_digest"] = prefix.digest()
             except Exception:  # noqa: BLE001
                 pass
         kv_tier = getattr(engine, "kv_tier", None)

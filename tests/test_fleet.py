@@ -1,0 +1,518 @@
+"""Fleet router tier: affinity stickiness, load spillover, ejection,
+retry discipline, stream pass-through, and the service-client breaker
+paths the router leans on.
+
+Stub replicas are plain gofr_tpu Apps (no engine) that speak the same
+dialect as examples/llm-server: SSE /generate, /stats with a fleet
+digest, and a health contributor named "engine" so PR 3's DOWN signal
+shape is exercised end-to-end.  The router under test is the REAL
+examples/router app booted on ephemeral ports.
+"""
+
+import importlib.util
+import json
+import os
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from gofr_tpu import App, Stream
+from gofr_tpu.config import MockConfig
+from gofr_tpu.datasource import Health, STATUS_DOWN, STATUS_UP
+from gofr_tpu.fleet.affinity import AffinityMap, AffinityRecorder, affinity_keys
+from gofr_tpu.fleet.policy import (AffinityPolicy, P2CPolicy,
+                                   RoundRobinPolicy, make_policy)
+from gofr_tpu.fleet.registry import FleetRegistry, Replica
+from gofr_tpu.http.errors import ServiceUnavailable
+from gofr_tpu.service import (CircuitBreakerConfig, CircuitOpenError,
+                              HTTPService, new_http_service)
+
+pytestmark = pytest.mark.fleet
+
+EXAMPLES = os.path.join(os.path.dirname(__file__), "..", "examples")
+
+
+def _load(example):
+    path = os.path.join(EXAMPLES, example, "main.py")
+    spec = importlib.util.spec_from_file_location(
+        f"fleet_example_{example.replace('-', '_')}", path)
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class StubReplica:
+    """llm-server-shaped backend without an engine: SSE /generate,
+    /stats with fleet digest, health contributor named "engine"."""
+
+    def __init__(self, name, tokens=3):
+        self.name = name
+        self.tokens = tokens
+        self.state = {
+            "status": STATUS_UP, "queue_depth": 0, "shed": False,
+            "retry_after": 2, "generation": f"{name}-gen1", "digest": [],
+            "die_after": None,
+        }
+        self.served = []
+        self.traceparents = []
+        app = App(config=MockConfig({
+            "HTTP_PORT": "0", "METRICS_PORT": "0", "APP_NAME": name,
+            "REQUEST_TIMEOUT": "30", "LOG_LEVEL": "ERROR"}))
+        st = self.state
+
+        app.container.add_health_contributor(
+            "engine", lambda: Health(status=st["status"], details={}))
+
+        @app.post("/generate")
+        def generate(ctx):
+            body = ctx.bind()
+            self.traceparents.append(ctx.request.traceparent)
+            if st["shed"]:
+                raise ServiceUnavailable("replica shedding",
+                                         retry_after_s=st["retry_after"])
+            self.served.append(body.get("prompt"))
+            die_after = st["die_after"]
+            n = self.tokens
+
+            def chunks():
+                for i in range(n):
+                    if die_after is not None and i >= die_after:
+                        raise RuntimeError("stub replica died mid-stream")
+                    yield {"text": f"{self.name}-t{i}"}
+                yield {"done": True, "tokens": n}
+
+            return Stream(chunks(), sse=True)
+
+        @app.get("/stats")
+        def stats(ctx):  # noqa: ARG001
+            return {
+                "queue_depth": st["queue_depth"], "active_slots": 0,
+                "fleet": {"duty_cycle": 0.25,
+                          "affinity": {"block": 8,
+                                       "generation": st["generation"],
+                                       "keys": list(st["digest"])}},
+            }
+
+        self.app = app
+
+    def start(self):
+        self.app.start()
+        self.url = f"http://127.0.0.1:{self.app.http_port}"
+        return self
+
+    def stop(self):
+        self.app.shutdown()
+
+
+class Harness:
+    """N stub replicas behind a REAL examples/router app."""
+
+    def __init__(self, n=2, **cfg):
+        self.replicas = [StubReplica(f"r{i}").start() for i in range(n)]
+        values = {
+            "HTTP_PORT": "0", "METRICS_PORT": "0", "APP_NAME": "router",
+            "REQUEST_TIMEOUT": "30", "LOG_LEVEL": "ERROR",
+            "FLEET_REPLICAS": ",".join(f"{r.name}={r.url}"
+                                       for r in self.replicas),
+            "FLEET_PROBE_S": "0.2", "FLEET_AFFINITY_BLOCK": "8",
+            "FLEET_BREAKER_INTERVAL_S": "0.3", "FLEET_RETRY_BUDGET": "2",
+        }
+        values.update({k: str(v) for k, v in cfg.items()})
+        self.app = _load("router").build_app(config=MockConfig(values))
+        self.app.start()
+        self.port = self.app.http_port
+
+    def replica(self, name):
+        return next(r for r in self.replicas if r.name == name)
+
+    def served_by(self, prompt):
+        return [r.name for r in self.replicas if prompt in r.served]
+
+    def generate(self, prompt, headers=None, timeout=10):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{self.port}/generate",
+            data=json.dumps({"prompt": prompt, "stream": True}).encode(),
+            headers={"Content-Type": "application/json", **(headers or {})},
+            method="POST")
+        events = []
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                status = resp.status
+                for line in resp:
+                    line = line.strip()
+                    if line.startswith(b"data: "):
+                        events.append(json.loads(line[6:]))
+        except urllib.error.HTTPError as err:
+            return err.code, json.loads(err.read().decode() or "null"), dict(
+                err.headers)
+        return status, events, {}
+
+    def debug_fleet(self):
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{self.port}/debug/fleet",
+                timeout=10) as resp:
+            return json.loads(resp.read().decode())["data"]
+
+    def wait_probe(self, predicate, timeout=5.0):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            snap = self.debug_fleet()
+            if predicate(snap):
+                return snap
+            time.sleep(0.1)
+        raise AssertionError(f"probe condition not reached: {self.debug_fleet()}")
+
+    def close(self):
+        self.app.shutdown()
+        for r in self.replicas:
+            r.stop()
+
+
+@pytest.fixture()
+def fleet():
+    harnesses = []
+
+    def build(n=2, **cfg):
+        h = Harness(n=n, **cfg)
+        harnesses.append(h)
+        return h
+
+    yield build
+    for h in harnesses:
+        h.close()
+
+
+# -- routing behaviour --------------------------------------------------------
+def test_affinity_same_session_sticks_to_one_replica(fleet):
+    h = fleet(n=2)
+    prompt = "session-alpha: the quick brown fox jumps over the lazy dog"
+    for _ in range(4):
+        status, events, _ = h.generate(prompt)
+        assert status == 200
+        assert events[-1].get("done") is True
+    names = h.served_by(prompt)
+    assert len(names) == 1, f"session bounced across {names}"
+    assert len(h.replica(names[0]).served) == 4
+    snap = h.debug_fleet()
+    assert snap["affinity"]["hits"] >= 3
+    assert snap["affinity"]["hit_rate"] > 0.5
+
+
+def test_saturated_preferred_replica_spills_by_queue_depth(fleet):
+    h = fleet(n=2, FLEET_SPILL_DEPTH=4)
+    prompt = "session-beta: shared prefix that should pin to one replica"
+    status, _, _ = h.generate(prompt)
+    assert status == 200
+    [preferred] = h.served_by(prompt)
+    other = next(r.name for r in h.replicas if r.name != preferred)
+    # saturate the preferred replica and let a probe observe it
+    h.replica(preferred).state["queue_depth"] = 50
+    h.wait_probe(lambda s: any(r["name"] == preferred
+                               and r["queue_depth"] == 50
+                               for r in s["replicas"]))
+    status, _, _ = h.generate(prompt)
+    assert status == 200
+    assert h.replica(other).served == [prompt]
+    snap = h.debug_fleet()
+    assert snap["routes"].get("spill", 0) >= 1
+
+
+def test_down_replica_ejected_then_probed_back_in(fleet):
+    h = fleet(n=2)
+    sick = h.replicas[0]
+    sick.state["status"] = STATUS_DOWN
+    snap = h.wait_probe(lambda s: any(r["name"] == sick.name
+                                      and r["state"] == "DOWN"
+                                      and not r["available"]
+                                      for r in s["replicas"]))
+    assert snap["available"] == 1
+    for i in range(3):
+        status, events, _ = h.generate(f"while-down prompt {i}")
+        assert status == 200 and events[-1].get("done") is True
+    assert sick.served == []
+    sick.state["status"] = STATUS_UP
+    h.wait_probe(lambda s: all(r["available"] for r in s["replicas"]))
+
+
+def test_shed_replica_retried_unstarted_and_retry_after_honored(fleet):
+    h = fleet(n=2, FLEET_POLICY="round_robin")
+    shedder = h.replicas[0]
+    shedder.state["shed"] = True
+    shedder.state["retry_after"] = 2
+    # round-robin hits the shedder half the time; every client call must
+    # still succeed via unstarted-retry on the healthy replica
+    for i in range(4):
+        status, events, _ = h.generate(f"shed-phase prompt {i}")
+        assert status == 200 and events[-1].get("done") is True
+    assert shedder.served == []
+    snap = h.debug_fleet()
+    assert snap["retries"].get("shed", 0) >= 1
+    # Retry-After honored: even after the replica stops shedding, the
+    # router keeps routing around it until the advertised window passes
+    shedder.state["shed"] = False
+    status, _, _ = h.generate("still-in-window prompt")
+    assert status == 200
+    assert shedder.served == []
+    time.sleep(2.2)
+    for i in range(6):
+        h.generate(f"after-window prompt {i}")
+    assert len(shedder.served) >= 1
+
+
+def test_midstream_death_never_double_sends_and_unstarted_requests_survive(fleet):
+    h = fleet(n=2)
+    prompt = "session-gamma: stream that will be cut down mid-flight"
+    status, _, _ = h.generate(prompt)
+    assert status == 200
+    [victim_name] = h.served_by(prompt)
+    victim = h.replica(victim_name)
+    survivor = next(r for r in h.replicas if r.name != victim_name)
+    victim.state["die_after"] = 1
+    status, events, _ = h.generate(prompt)
+    # the stream STARTED: client gets the tokens that made it out plus a
+    # terminal error event — and the request is never replayed elsewhere
+    assert status == 200
+    assert any("error" in e for e in events)
+    assert not any(e.get("done") for e in events)
+    assert victim.served.count(prompt) == 2
+    assert survivor.served.count(prompt) == 0
+    snap = h.debug_fleet()
+    assert snap["stream_breaks"] >= 1
+    # now hard-kill the victim entirely: UNSTARTED requests must keep
+    # succeeding through connect-error retry + probe ejection
+    victim.stop()
+    for i in range(4):
+        status, events, _ = h.generate(f"post-kill prompt {i}")
+        assert status == 200 and events[-1].get("done") is True
+    h.wait_probe(lambda s: any(r["name"] == victim_name and not r["available"]
+                               for r in s["replicas"]))
+    h.replicas.remove(victim)  # already stopped; keep close() idempotent
+
+
+def test_traceparent_spans_router_to_replica(fleet):
+    h = fleet(n=1)
+    trace_id = "0af7651916cd43dd8448eb211c80319c"
+    span_id = "b7ad6b7169203331"
+    status, _, _ = h.generate("trace me please",
+                              headers={"traceparent":
+                                       f"00-{trace_id}-{span_id}-01"})
+    assert status == 200
+    received = h.replicas[0].traceparents[-1]
+    assert received is not None
+    parts = received.split("-")
+    assert parts[1] == trace_id, "trace id must span router -> replica"
+    assert parts[2] != span_id, "replica must see a child span, not ours"
+
+
+def test_debug_fleet_snapshot_e2e(fleet):
+    h = fleet(n=2)
+    h.generate("snapshot session prompt one")
+    h.generate("snapshot session prompt one")
+    snap = h.debug_fleet()
+    assert snap["policy"] == "affinity"
+    assert snap["routes_total"] == 2
+    assert {r["name"] for r in snap["replicas"]} == {"r0", "r1"}
+    for row in snap["replicas"]:
+        assert {"state", "available", "breaker_open", "queue_depth",
+                "inflight", "load", "affinity_entries",
+                "stream_breaks"} <= set(row)
+    assert snap["affinity"]["map_size"] >= 1
+    assert snap["available"] == 2
+
+
+def test_router_health_contributor_follows_fleet(fleet):
+    h = fleet(n=2)
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{h.port}/.well-known/health",
+            timeout=10) as resp:
+        body = json.loads(resp.read().decode())["data"]
+    assert body["details"]["fleet"]["status"] == STATUS_UP
+    for r in h.replicas:
+        r.state["status"] = STATUS_DOWN
+    h.wait_probe(lambda s: s["available"] == 0)
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{h.port}/.well-known/health",
+            timeout=10) as resp:
+        body = json.loads(resp.read().decode())["data"]
+    assert body["details"]["fleet"]["status"] == STATUS_DOWN
+
+
+def test_no_replica_available_returns_503_with_retry_after(fleet):
+    h = fleet(n=2)
+    for r in h.replicas:
+        r.state["status"] = STATUS_DOWN
+    h.wait_probe(lambda s: s["available"] == 0)
+    status, body, headers = h.generate("nowhere to go")
+    assert status == 503
+    assert "error" in body
+    assert int(headers.get("Retry-After", 0)) >= 1
+
+
+# -- service-client breaker paths (previously dead in the serving path) -------
+def test_circuit_breaker_open_probe_close_cycle():
+    port = _free_port()
+    svc = new_http_service(f"http://127.0.0.1:{port}", None, None,
+                           CircuitBreakerConfig(threshold=1, interval_s=0.2))
+    for _ in range(2):  # consecutive failures past the threshold
+        with pytest.raises(Exception):
+            svc.get(None, "/stats")
+    assert svc.open is True
+    with pytest.raises(CircuitOpenError):
+        svc.get(None, "/stats")
+    # replica comes back on the same address: the breaker's own prober
+    # must close the circuit without any caller help
+    app = App(config=MockConfig({"HTTP_PORT": str(port), "METRICS_PORT": "0",
+                                 "APP_NAME": "revived", "LOG_LEVEL": "ERROR"}))
+
+    @app.get("/stats")
+    def stats(ctx):  # noqa: ARG001
+        return {"ok": True}
+
+    app.start()
+    try:
+        deadline = time.time() + 5
+        while svc.open and time.time() < deadline:
+            time.sleep(0.1)
+        assert svc.open is False, "probe loop never closed the breaker"
+        resp = svc.get(None, "/stats")
+        assert resp.status_code == 200
+    finally:
+        app.shutdown()
+
+
+def test_http_service_health_check_down_when_unreachable():
+    svc = HTTPService(f"http://127.0.0.1:{_free_port()}", timeout_s=0.5)
+    health = svc.health_check()
+    assert health.status == STATUS_DOWN
+
+
+def test_http_service_streaming_response_passthrough():
+    app = App(config=MockConfig({"HTTP_PORT": "0", "METRICS_PORT": "0",
+                                 "APP_NAME": "sse", "LOG_LEVEL": "ERROR"}))
+
+    @app.post("/gen")
+    def gen(ctx):  # noqa: ARG001
+        return Stream(iter([{"text": "a"}, {"done": True}]), sse=True)
+
+    app.start()
+    try:
+        svc = HTTPService(f"http://127.0.0.1:{app.http_port}", timeout_s=5)
+        resp = svc.request(None, "POST", "/gen", body={"x": 1}, stream=True)
+        assert resp.status_code == 200
+        assert "text/event-stream" in (resp.header("Content-Type") or "")
+        assert resp.body == b""  # not buffered
+        payload = b"".join(resp.iter_chunks())
+        assert b'data: {"text": "a"}' in payload
+        assert b'"done": true' in payload
+        resp.close()
+    finally:
+        app.shutdown()
+
+
+# -- fast units ---------------------------------------------------------------
+def test_affinity_keys_stable_and_cumulative():
+    assert affinity_keys("") == []
+    short = affinity_keys("abcd", block=8)
+    assert len(short) == 1
+    long = affinity_keys("abcdefgh" * 3, block=8)
+    assert len(long) == 3
+    assert long[0] != short[0]  # different 8-char leading blocks
+    assert affinity_keys("abcdefgh" * 3, block=8) == long  # deterministic
+    # shared leading block -> shared first key
+    assert (affinity_keys("abcdefghXXXX", block=8)[0]
+            == affinity_keys("abcdefghYYYY", block=8)[0])
+
+
+def test_affinity_map_learn_lookup_forget_and_digest_warmup():
+    amap = AffinityMap(capacity=8)
+    keys = affinity_keys("abcdefgh" * 2, block=8)
+    amap.learn(keys, "r0")
+    assert amap.lookup(keys) == ("r0", keys[-1])  # longest prefix wins
+    # digest merge never overrides first-hand learning
+    amap.merge_digest("r1", keys)
+    assert amap.lookup(keys)[0] == "r0"
+    # ...but warms unknown keys (router-restart path)
+    recorder = AffinityRecorder(block=8)
+    recorder.record("zyxwvuts" * 2)
+    fresh = AffinityMap()
+    fresh.merge_digest("r1", recorder.digest()["keys"])
+    assert fresh.lookup(affinity_keys("zyxwvuts" * 2, block=8))[0] == "r1"
+    assert amap.forget("r0") == len(keys)
+    assert amap.lookup(keys) == (None, None)
+
+
+class _FakeReplica:
+    def __init__(self, name, load):
+        self.name = name
+        self._load = load
+
+    def load(self):
+        return self._load
+
+
+def test_policy_units():
+    a, b = _FakeReplica("a", 1), _FakeReplica("b", 5)
+    amap = AffinityMap()
+    rr = RoundRobinPolicy()
+    picks = [rr.choose([a, b], [], amap)[0].name for _ in range(4)]
+    assert picks == ["a", "b", "a", "b"]
+    p2c = P2CPolicy(seed=7)
+    for _ in range(8):
+        replica, reason = p2c.choose([a, b], [], amap)
+        assert replica.name == "a" and reason == "p2c"
+    pol = AffinityPolicy(spill_depth=4)
+    keys = affinity_keys("abcdefgh", block=8)
+    assert pol.choose([a, b], keys, amap)[1] == "miss"
+    amap.learn(keys, "b")
+    replica, reason = pol.choose([a, b], keys, amap)
+    assert (replica.name, reason) == ("a", "spill")  # b at 5 >= depth 4
+    b._load = 2
+    replica, reason = pol.choose([a, b], keys, amap)
+    assert (replica.name, reason) == ("b", "affinity")
+    amap.learn(keys, "gone")
+    assert pol.choose([a, b], keys, amap)[1] == "failover"
+    assert make_policy("round_robin").name == "round_robin"
+    with pytest.raises(ValueError):
+        make_policy("nonsense")
+
+
+def test_registry_from_config_parses_named_and_bare_urls():
+    config = MockConfig({
+        "FLEET_REPLICAS":
+            "alpha=http://h0:8000, http://h1:8000 ,beta=http://h2:9000",
+        "FLEET_PROBE_S": "0.7"})
+    registry = FleetRegistry.from_config(config)
+    assert [(r.name, r.address) for r in registry.replicas] == [
+        ("alpha", "http://h0:8000"), ("r1", "http://h1:8000"),
+        ("beta", "http://h2:9000")]
+    assert registry.probe_s == 0.7
+    with pytest.raises(ValueError):
+        FleetRegistry.from_config(MockConfig({}))
+
+
+def test_replica_shed_window_and_load_accounting():
+    replica = Replica("r0", "http://127.0.0.1:1")
+    assert replica.load() == 0
+    replica.begin()
+    replica.queue_depth = 3
+    assert replica.load() == 4
+    replica.end()
+    assert replica.load() == 3
+    replica.state = STATUS_UP
+    assert replica.available()
+    replica.note_shed(0.3)
+    assert not replica.available()
+    time.sleep(0.35)
+    assert replica.available()

@@ -291,16 +291,17 @@ def test_metric_inventory_consistency():
     be registered by the runtime's registration paths AND listed in
     docs/observability.md — the gate that catches silent drift like PR 1's
     new gauges landing unregistered/undocumented."""
-    tpu_dir = os.path.join(os.path.dirname(__file__), "..", "gofr_tpu",
-                           "tpu")
+    pkg = os.path.join(os.path.dirname(__file__), "..", "gofr_tpu")
     recorded = set()
-    for fname in sorted(os.listdir(tpu_dir)):
-        if not fname.endswith(".py"):
-            continue
-        with open(os.path.join(tpu_dir, fname), encoding="utf-8") as fp:
-            for name in _RECORD_CALL.findall(fp.read()):
-                if name.startswith("app_tpu_"):
-                    recorded.add(name)
+    for sub in ("tpu", "fleet"):
+        scan_dir = os.path.join(pkg, sub)
+        for fname in sorted(os.listdir(scan_dir)):
+            if not fname.endswith(".py"):
+                continue
+            with open(os.path.join(scan_dir, fname), encoding="utf-8") as fp:
+                for name in _RECORD_CALL.findall(fp.read()):
+                    if name.startswith("app_tpu_"):
+                        recorded.add(name)
     assert recorded, "inventory scan found no recorded metrics (regex rot?)"
     # the step-anatomy names must be IN the scan (guards regex rot against
     # the stepledger module's recording style)
@@ -314,7 +315,12 @@ def test_metric_inventory_consistency():
     # against disagg.py's hand-off recording style)
     assert any(n.startswith("app_tpu_disagg_") for n in recorded), \
         "disagg hand-off counters vanished from the inventory scan"
+    # the fleet-router family must be IN the scan (guards regex rot
+    # against gofr_tpu/fleet's recording style)
+    assert any(n.startswith("app_tpu_fleet_") for n in recorded), \
+        "fleet router counters vanished from the inventory scan"
 
+    from gofr_tpu.fleet import register_fleet_metrics
     from gofr_tpu.tpu.device import TPUClient
     from gofr_tpu.tpu.disagg import register_disagg_metrics
     from gofr_tpu.tpu.flightrecorder import register_slo_gauges
@@ -328,6 +334,7 @@ def test_metric_inventory_consistency():
     register_utilization_metrics(manager)
     register_step_metrics(manager)  # idempotent next to register_metrics
     register_disagg_metrics(manager)
+    register_fleet_metrics(manager)
     registered = set(manager._store)
     missing = recorded - registered
     assert not missing, (
@@ -357,9 +364,11 @@ def test_debug_endpoint_inventory_documented():
     a new operator surface cannot ship undocumented."""
     pkg = os.path.join(os.path.dirname(__file__), "..", "gofr_tpu")
     sources = [os.path.join(pkg, "app.py")]
-    tpu_dir = os.path.join(pkg, "tpu")
-    sources += [os.path.join(tpu_dir, f) for f in sorted(os.listdir(tpu_dir))
-                if f.endswith(".py")]
+    for sub in ("tpu", "fleet"):
+        sub_dir = os.path.join(pkg, sub)
+        sources += [os.path.join(sub_dir, f)
+                    for f in sorted(os.listdir(sub_dir))
+                    if f.endswith(".py")]
     routes = set()
     for path in sources:
         with open(path, encoding="utf-8") as fp:
@@ -367,7 +376,7 @@ def test_debug_endpoint_inventory_documented():
     # regex-rot guard: the known surfaces must all be in the scan
     for expected in ("/debug/profile", "/debug/requests", "/debug/engine",
                      "/debug/steps", "/debug/faults", "/debug/slo",
-                     "/debug/incidents", "/debug/disagg"):
+                     "/debug/incidents", "/debug/disagg", "/debug/fleet"):
         assert expected in routes, f"scan missed {expected} (regex rot?)"
 
     docs = os.path.join(os.path.dirname(__file__), "..", "docs",

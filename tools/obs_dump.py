@@ -151,6 +151,26 @@ def poll_once(server: str, metrics_base: str) -> dict:
     except Exception as exc:  # noqa: BLE001 - colocated servers lack the route
         entry["disagg_error"] = str(exc)
     try:
+        body = json.loads(_get(server.rstrip("/") + "/debug/fleet"))
+        snap = body.get("data", body)
+        # replica table compressed to the routing-relevant columns; the
+        # router's counters ride along whole (they're already bounded)
+        entry["fleet"] = {
+            "policy": snap.get("policy"),
+            "available": snap.get("available"),
+            "routes": snap.get("routes"),
+            "retries": snap.get("retries"),
+            "stream_breaks": snap.get("stream_breaks"),
+            "affinity": snap.get("affinity"),
+            "replicas": [
+                {k: r.get(k) for k in (
+                    "name", "state", "available", "breaker_open", "shedding",
+                    "queue_depth", "inflight", "stream_breaks")}
+                for r in snap.get("replicas", [])],
+        }
+    except Exception as exc:  # noqa: BLE001 - only router-tier processes serve it
+        entry["fleet_error"] = str(exc)
+    try:
         entry["gauges"] = scrape_gauges(metrics_base)
     except Exception as exc:  # noqa: BLE001
         entry["metrics_error"] = str(exc)

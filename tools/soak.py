@@ -8,8 +8,9 @@ weak #4: "soak results are claims, not artifacts"):
     python tools/soak.py paged-int8  # paged pool, int8 pages + weights
     python tools/soak.py spec        # speculative decoding (paged pool)
     python tools/soak.py chat        # multi-turn sessions, tiered KV cache
+    python tools/soak.py router      # fleet front door over 2 replicas
     python tools/soak.py multihost   # two-process live-traffic admission
-    python tools/soak.py all         # the five in sequence
+    python tools/soak.py all         # the seven in sequence
     python tools/soak.py all --seconds 180 --threads 6
 
 Each profile boots an engine, runs N seconds of Poisson-arrival traffic
@@ -533,11 +534,238 @@ def run_disagg(seconds: float, n_threads: int, preset: str) -> bool:
     return ok
 
 
+def run_router(seconds: float, n_threads: int, preset: str) -> bool:
+    """Fleet front-door soak (gofr_tpu/fleet): two in-process llm-server
+    replicas behind the REAL examples/router app, multi-turn session
+    traffic over HTTP SSE, and a mid-run chaos-kill of one replica — a
+    fault-plane reset storm that trips PR 3's breaker (engine DOWN +
+    503/Retry-After sheds while the storm holds, half-open recovery
+    after BREAKER_COOLDOWN_S). Pass = ZERO failed client requests
+    through the kill (the per-replica gate PR 3 established, now
+    fleet-wide: the router retries UNSTARTED requests onto the healthy
+    replica, ejects the sick one, probes it back in) + the sick replica
+    OBSERVED unavailable mid-run + recovered at the end + an affinity
+    hit rate in the evidence."""
+    import importlib.util
+    import urllib.error
+    import urllib.request
+
+    from gofr_tpu.config import MockConfig
+
+    def _example(name):
+        path = os.path.join(os.path.dirname(__file__), "..", "examples",
+                            name, "main.py")
+        spec = importlib.util.spec_from_file_location(
+            "soak_" + name.replace("-", "_"), path)
+        module = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(module)
+        return module
+
+    llm = _example("llm-server")
+    router_mod = _example("router")
+    small = preset == "debug"
+    base_cfg = {
+        "HTTP_PORT": "0", "METRICS_PORT": "0", "GRPC_PORT": "0",
+        "MODEL_PRESET": preset, "PAGED": "true",
+        "PAGE_SIZE": "16" if small else "128",
+        "PREFIX_CACHE": "true",
+        "MAX_SEQ_LEN": "256" if small else "1024",
+        "MAX_BATCH": "8", "WARMUP": "true",
+        "REQUEST_TIMEOUT": "120", "LOG_LEVEL": "ERROR",
+        # survive the storm quickly: tight storm budget, short cooldown
+        "ENGINE_RETRY_BUDGET": "4", "RESET_STORM_MAX": "2",
+        "BREAKER_COOLDOWN_S": "2",
+        # no ./incidents writes from a soak tool run
+        "INCIDENT_AUTOPSY": "false",
+    }
+    replicas = []
+    for i in range(2):
+        values = dict(base_cfg, APP_NAME=f"replica{i}")
+        if i == 1:
+            values["FAULT_INJECTION"] = "true"  # the chaos-kill target
+        app = llm.build_app(config=MockConfig(values))
+        app.start()
+        replicas.append(app)
+    sick = replicas[1]
+    router_app = router_mod.build_app(config=MockConfig({
+        "HTTP_PORT": "0", "METRICS_PORT": "0", "APP_NAME": "router",
+        "REQUEST_TIMEOUT": "120", "LOG_LEVEL": "ERROR",
+        "FLEET_REPLICAS": ",".join(
+            f"r{i}=http://127.0.0.1:{a.http_port}"
+            for i, a in enumerate(replicas)),
+        "FLEET_PROBE_S": "0.5", "FLEET_AFFINITY_BLOCK": "24",
+        "FLEET_RETRY_BUDGET": "3",
+    }))
+    router_app.start()
+    base = f"http://127.0.0.1:{router_app.http_port}"
+
+    n_sessions = max(6, n_threads * 3)
+    session_rng = random.Random(42)
+    alphabet = "abcdefghijklmnopqrstuvwxyz "
+    sessions = [
+        {"history": f"system prompt {s:02d}: " + "".join(
+            session_rng.choice(alphabet) for _ in range(60))}
+        for s in range(n_sessions)]
+    stats = {"profile": "router", "preset": preset,
+             "ok": 0, "errors": 0, "shed": 0, "tokens": 0}
+    errors = []
+    lock = threading.Lock()
+    t0 = time.time()
+    stop_at = t0 + seconds
+
+    def worker(idx: int) -> None:
+        rng = random.Random(3000 + idx)
+        while time.time() < stop_at:
+            # zipf-ish pick: hot head sessions dominate (the affinity +
+            # prefix-cache load), uniform tail revisits cold ones
+            session = sessions[
+                rng.randrange(n_sessions) if rng.random() < 0.3
+                else min(int(rng.paretovariate(1.1)) - 1, n_sessions - 1)]
+            with lock:
+                history = session["history"]
+            prompt = f"{history} u{rng.randrange(999)}"
+            req = urllib.request.Request(
+                base + "/generate",
+                data=json.dumps({"prompt": prompt, "stream": True,
+                                 "max_tokens": rng.choice([4, 8, 12])}
+                                ).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST")
+            try:
+                events = []
+                with urllib.request.urlopen(req, timeout=120) as resp:
+                    for line in resp:
+                        line = line.strip()
+                        if line.startswith(b"data: "):
+                            events.append(json.loads(line[6:]))
+            except urllib.error.HTTPError as err:
+                err.read()
+                with lock:
+                    if err.code == 503:
+                        stats["shed"] += 1
+                    else:
+                        stats["errors"] += 1
+                        errors.append(f"HTTP {err.code}")
+                time.sleep(float(err.headers.get("Retry-After") or 1.0)
+                           if err.code == 503 else 0.1)
+                continue
+            except Exception as exc:  # noqa: BLE001 - every failure is evidence
+                with lock:
+                    stats["errors"] += 1
+                    errors.append(repr(exc)[:160])
+                continue
+            done = [e for e in events if e.get("done")]
+            broke = [e for e in events if "error" in e]
+            with lock:
+                if broke or not done:
+                    # a started stream that ends without its done event IS
+                    # a failed client request — the gate this soak exists for
+                    stats["errors"] += 1
+                    errors.append(f"stream broke: {events[-2:]!r}"[:160])
+                else:
+                    stats["ok"] += 1
+                    stats["tokens"] += int(done[0].get("tokens", 0))
+                    # grow the trunk (capped) so later turns share a
+                    # longer prefix with earlier ones
+                    if len(session["history"]) < 150:
+                        session["history"] = (
+                            session["history"]
+                            + f" turn{stats['ok'] % 97}")[:150]
+
+    # chaos-kill: arm a decode reset storm on the sick replica mid-run —
+    # in-flight streams REPLAY inside the replica (PR 3), the storm trips
+    # its breaker (health DOWN + sheds), the router must route around it
+    kill_at = max(2.0, seconds / 2.0)
+    storm_plan = [
+        {"site": "engine.decode", "every": 25, "times": 2,
+         "action": "raise"}]
+
+    def _chaos_kill():
+        sick.engine.faults.arm(storm_plan, seed=0)
+
+    killer = threading.Timer(kill_at, _chaos_kill)
+    killer.daemon = True
+    killer.start()
+
+    # evidence poller: the /debug/fleet timeline is the proof the kill
+    # registered fleet-wide (ejection) and healed (probe-back)
+    timeline = []
+    poll_stop = threading.Event()
+
+    def _poll_fleet():
+        while not poll_stop.wait(0.5):
+            try:
+                with urllib.request.urlopen(base + "/debug/fleet",
+                                            timeout=5) as resp:
+                    snap = json.loads(resp.read().decode())["data"]
+            except Exception:  # noqa: BLE001 - poller must outlive hiccups
+                continue
+            timeline.append({
+                "t": round(time.time() - t0, 1),
+                "available": snap["available"],
+                "replicas": {r["name"]: {
+                    "state": r["state"], "available": r["available"],
+                    "breaker_open": r["breaker_open"],
+                    "shedding": r["shedding"]}
+                    for r in snap["replicas"]}})
+
+    poller = threading.Thread(target=_poll_fleet, daemon=True)
+    poller.start()
+    threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=seconds + 180)
+    poll_stop.set()
+    poller.join(timeout=5)
+    killer.cancel()
+    final = None
+    try:
+        with urllib.request.urlopen(base + "/debug/fleet",
+                                    timeout=10) as resp:
+            final = json.loads(resp.read().decode())["data"]
+    except Exception:  # noqa: BLE001
+        pass
+    router_app.shutdown()
+    for app in replicas:
+        app.shutdown()
+
+    stats["seconds"] = round(time.time() - t0, 1)
+    stats["kill_at_s"] = kill_at
+    sick_out_polls = sum(
+        1 for e in timeline
+        if e["t"] >= kill_at and not e["replicas"]["r1"]["available"])
+    stats["sick_replica_unavailable_polls"] = sick_out_polls
+    stats["timeline"] = [e for e in timeline
+                         if e["available"] < len(replicas)][:24]
+    if final is not None:
+        stats["routes"] = final.get("routes")
+        stats["retries"] = final.get("retries")
+        stats["stream_breaks"] = final.get("stream_breaks")
+        stats["affinity"] = final.get("affinity")
+        stats["replicas_final"] = [
+            {k: r.get(k) for k in ("name", "state", "available",
+                                   "queue_depth", "stream_breaks")}
+            for r in final.get("replicas", [])]
+    if errors:
+        stats["error_samples"] = errors[:8]
+    hit_rate = (final or {}).get("affinity", {}).get("hit_rate")
+    recovered = (final is not None
+                 and all(r["available"] for r in final["replicas"]))
+    ok = (stats["errors"] == 0 and stats["shed"] == 0 and stats["ok"] > 0
+          and sick_out_polls > 0 and recovered
+          and hit_rate is not None and hit_rate > 0)
+    stats["pass"] = ok
+    print(json.dumps(stats))
+    return ok
+
+
 def main() -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("profile", nargs="?", default="all",
                         choices=["mixed", "paged-int8", "spec", "chat",
-                                 "disagg", "multihost", "all"])
+                                 "disagg", "router", "multihost", "all"])
     parser.add_argument("--seconds", type=float, default=120.0)
     parser.add_argument("--threads", type=int, default=4)
     parser.add_argument("--chaos", action="store_true",
@@ -553,13 +781,15 @@ def main() -> int:
         jax.config.update("jax_platforms", platform)
     preset = os.environ.get("SOAK_PRESET", "debug")
 
-    profiles = (["mixed", "paged-int8", "spec", "chat", "disagg",
+    profiles = (["mixed", "paged-int8", "spec", "chat", "disagg", "router",
                  "multihost"]
                 if args.profile == "all" else [args.profile])
     results = []
     for p in profiles:
         if p == "disagg":
             results.append(run_disagg(args.seconds, args.threads, preset))
+        elif p == "router":
+            results.append(run_router(args.seconds, args.threads, preset))
         elif p == "multihost":
             # under `all`, cap the two-process tier so it doesn't dominate
             # the sequence's wall time (the plane's invariants saturate
