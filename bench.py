@@ -592,6 +592,119 @@ def run_phase_fleet(sessions=6, turns=4, max_tokens=8):
             "fleet_affinity_hit_rate": hit_rate}
 
 
+def run_phase_qos(n_requests=12, max_tokens=8, lane_jobs=8,
+                  lane_max_tokens=160):
+    """QoS serving plane (gofr_tpu/tpu/qos.py): interactive TTFT/TPOT
+    with and without a saturating batch lane on ONE QOS=true server.
+
+    Arm A measures interactive latency on a quiet engine. Arm B
+    publishes long offline jobs to the batch lane until it is saturated
+    (inflight at its cap), then re-measures the SAME interactive
+    traffic riding over the busy engine. The delta is what the class
+    bands + reserved-slot quota buy: interactive requests jump the
+    batch queue instead of waiting behind offline decodes. Per-class
+    goodput comes from /debug/qos afterwards. Returns
+    {qos_interactive_ttft_quiet_ms, qos_interactive_ttft_saturated_ms,
+    qos_interactive_ttft_protect_ms, qos_interactive_tpot_quiet_ms,
+    qos_interactive_tpot_saturated_ms, qos_goodput_interactive,
+    qos_goodput_batch, qos_lane_completed}."""
+    import urllib.request
+
+    from gofr_tpu.config import MockConfig
+
+    llm = _load_example("llm-server")
+    app = llm.build_app(config=MockConfig({
+        "HTTP_PORT": "0", "METRICS_PORT": "0", "GRPC_PORT": "0",
+        "APP_NAME": "bench-qos", "MODEL_PRESET": "debug",
+        "PAGED": "true", "PAGE_SIZE": "16", "MAX_SEQ_LEN": "256",
+        "PREFILL_BUCKETS": "16,64,256", "MAX_BATCH": "4",
+        "WARMUP": "true", "REQUEST_TIMEOUT": "300", "LOG_LEVEL": "ERROR",
+        "QOS": "true", "PUBSUB_BACKEND": "inproc",
+        "QOS_LANE_MAX_INFLIGHT": "3", "INCIDENT_AUTOPSY": "false"}))
+    app.start()
+    base = f"http://127.0.0.1:{app.http_port}"
+    lane = app.engine.qos.lane
+    broker = app.container.pubsub
+
+    def _measure(tag):
+        """Client-clock TTFT + TPOT over n_requests streamed calls."""
+        ttfts, tpots = [], []
+        for i in range(n_requests):
+            req = urllib.request.Request(
+                base + "/generate",
+                data=json.dumps({"prompt": f"{tag} ping {i}",
+                                 "stream": True,
+                                 "max_tokens": max_tokens}).encode(),
+                headers={"Content-Type": "application/json",
+                         "X-QoS-Class": "interactive",
+                         "X-Tenant": "bench"}, method="POST")
+            t0 = time.monotonic()
+            first = last = None
+            n_tokens = 0
+            with urllib.request.urlopen(req, timeout=300) as resp:
+                for line in resp:
+                    if not line.startswith(b"data: "):
+                        continue
+                    now = time.monotonic()
+                    if first is None:
+                        first = now
+                    event = json.loads(line[6:].strip())
+                    if event.get("done"):
+                        break
+                    last = now
+                    n_tokens += 1
+            if first is None:
+                raise RuntimeError("stream ended before any token")
+            ttfts.append((first - t0) * 1e3)
+            if last is not None and n_tokens > 1:
+                tpots.append((last - first) * 1e3 / (n_tokens - 1))
+        ttfts.sort()
+        tpots.sort()
+        return (ttfts[len(ttfts) // 2],
+                tpots[len(tpots) // 2] if tpots else None)
+
+    try:
+        ttft_quiet, tpot_quiet = _measure("quiet")
+
+        for i in range(lane_jobs):
+            broker.publish("qos.batch.jobs", json.dumps(
+                {"prompt": f"offline shard {i}",
+                 "max_tokens": lane_max_tokens,
+                 "tenant": "offline", "job_id": i}).encode())
+        deadline = time.time() + 30.0
+        while time.time() < deadline and lane.stats()["inflight"] < 1:
+            time.sleep(0.05)
+        if lane.stats()["inflight"] < 1:
+            raise RuntimeError("batch lane never picked up a job")
+
+        ttft_sat, tpot_sat = _measure("saturated")
+
+        body = json.loads(urllib.request.urlopen(
+            base + "/debug/qos", timeout=10).read())
+        snap = body.get("data", body)
+        classes = snap.get("classes") or {}
+        goodput = {c: (classes.get(c) or {}).get("goodput")
+                   for c in ("interactive", "batch")}
+        # let the lane drain so shutdown isn't tearing down live decodes
+        drain_deadline = time.time() + 120.0
+        while time.time() < drain_deadline and lane.depth() > 0:
+            time.sleep(0.25)
+        completed = lane.stats()["completed"]
+    finally:
+        app.shutdown()
+    return {"qos_interactive_ttft_quiet_ms": round(ttft_quiet, 2),
+            "qos_interactive_ttft_saturated_ms": round(ttft_sat, 2),
+            "qos_interactive_ttft_protect_ms": round(ttft_sat - ttft_quiet,
+                                                     2),
+            "qos_interactive_tpot_quiet_ms": (
+                round(tpot_quiet, 2) if tpot_quiet is not None else None),
+            "qos_interactive_tpot_saturated_ms": (
+                round(tpot_sat, 2) if tpot_sat is not None else None),
+            "qos_goodput_interactive": goodput["interactive"],
+            "qos_goodput_batch": goodput["batch"],
+            "qos_lane_completed": completed}
+
+
 class _Record:
     """Cumulative result emitter: every update() reprints the full JSON line,
     so a crash after phase N still leaves phase N's line as the last parsable
@@ -1557,6 +1670,29 @@ def main() -> None:
               f"{exc}", file=sys.stderr)
         record.update(fleet_error=f"{type(exc).__name__}: {exc}"[:200])
         _note_wedge(exc, record, "FL")
+
+    # ---- QS: QoS plane — interactive TTFT under a saturating batch lane ---
+    # After FL for the same reason: one debug-preset boot on a freed host.
+    # Measures what the class bands buy: how much interactive TTFT
+    # degrades when the batch lane keeps every spare slot decoding.
+    try:
+        if full_run and _left() > 180 and not _WEDGED:
+            qs = run_phase_qos()
+            print(f"[bench] QS qos: interactive TTFT quiet "
+                  f"{qs['qos_interactive_ttft_quiet_ms']:.1f}ms vs "
+                  f"saturated {qs['qos_interactive_ttft_saturated_ms']:.1f}"
+                  f"ms (protect delta "
+                  f"{qs['qos_interactive_ttft_protect_ms']:.1f}ms) "
+                  f"t={_spent():.0f}s", file=sys.stderr)
+            record.update(**qs)
+        elif full_run:
+            record.update(qos_skipped=("device wedged" if _WEDGED
+                                       else "budget"))
+    except Exception as exc:  # noqa: BLE001 - keep earlier phases' record
+        print(f"[bench] QS phase failed (earlier results preserved): "
+              f"{exc}", file=sys.stderr)
+        record.update(qos_error=f"{type(exc).__name__}: {exc}"[:200])
+        _note_wedge(exc, record, "QS")
 
     # ---- M2: BERT /embed over gRPC (BASELINE config 3, labeled extra) -----
     # Last on purpose: every LLM engine is stopped, so its HBM is free, and

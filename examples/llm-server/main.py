@@ -441,11 +441,22 @@ def build_app(config=None, engine=None) -> App:
     # quarantines. INCIDENT_AUTOPSY=false opts out; SLO_BURN_* /
     # INCIDENT_* tune windows, thresholds, and the capture rate limit
     if app.config.get_bool("INCIDENT_AUTOPSY", True):
-        app.enable_incident_autopsy(engine)
+        burn, _ = app.enable_incident_autopsy(engine)
+        # the soak/bench harnesses re-target SLO thresholds mid-run (a
+        # CPU-host baseline differs 100x from a TPU pod's); exposing the
+        # burn engine keeps that tuning out of the engine's internals
+        app.slo_burn = burn
     # chaos plane: POST /debug/faults + engine/executor/device fault hooks.
     # HARD-gated on FAULT_INJECTION=true — disabled (the default) keeps the
     # zero-overhead faults=None fast path and the endpoint 404s
     app.enable_fault_injection(engine)
+    # QoS serving plane: tenant classes + burn-actuated shed ladder +
+    # batch lane (GET /debug/qos, app_tpu_qos_*). Opt-IN (QOS=true): the
+    # ladder actuates on the burn engine above, and default SLO targets
+    # are TPU-scale — a CPU test host would page immediately and shed
+    # legacy traffic that never asked for QoS semantics
+    if app.config.get_bool("QOS", False):
+        app.enable_qos(engine)
     tokenizer: ByteTokenizer = engine.tokenizer
     # disaggregated pair (DISAGG_MODE=both): the router is the front door
     # — prefill pool runs the prompt, decode pool streams the rest — and
@@ -493,6 +504,14 @@ def build_app(config=None, engine=None) -> App:
         except (TypeError, ValueError) as exc:
             raise InvalidParam(["priority", "min_tokens", "top_p",
                                 "top_k"]) from exc
+        # QoS class + tenant: header wins over body; unknown class
+        # strings 400 inside submit (tpu/qos.py normalize), never a
+        # silent default. With QOS off the values still thread through
+        # harmlessly (engine.qos is None → no banding, no gates)
+        qos_class = (ctx.request.header("X-QoS-Class")
+                     or body.get("class") or None)
+        tenant = str(ctx.request.header("X-Tenant")
+                     or body.get("tenant") or "")
         try:
             request = submitter.submit(
                 tokenizer.encode(prompt), max_new_tokens=max_tokens,
@@ -500,7 +519,7 @@ def build_app(config=None, engine=None) -> App:
                 span=ctx.span,  # batch.id/slot correlation lands on span
                 traceparent=ctx.request.traceparent,  # engine child spans
                 priority=priority, min_tokens=min_tokens, top_p=top_p,
-                top_k=top_k)
+                top_k=top_k, qos_class=qos_class, tenant=tenant)
         except ValueError as exc:
             raise InvalidParam([str(exc)]) from exc
         except Exception as exc:  # noqa: BLE001 - sheds → 503 + Retry-After

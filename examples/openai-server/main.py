@@ -88,9 +88,14 @@ def build_app(**kw) -> App:
     # incident autopsy plane: GET /debug/slo + /debug/incidents (llm-server
     # parity; INCIDENT_AUTOPSY=false opts out, SLO_BURN_*/INCIDENT_* tune)
     if app.config.get_bool("INCIDENT_AUTOPSY", True):
-        app.enable_incident_autopsy(engine)
+        burn, _ = app.enable_incident_autopsy(engine)
+        app.slo_burn = burn    # llm-server parity: harnesses re-target SLOs
     # chaos plane (llm-server parity): 404s unless FAULT_INJECTION=true
     app.enable_fault_injection(engine)
+    # QoS serving plane (llm-server parity): opt-IN via QOS=true —
+    # classes/quotas/shed ladder/batch lane + GET /debug/qos
+    if app.config.get_bool("QOS", False):
+        app.enable_qos(engine)
     # disaggregated pair (DISAGG_MODE=both, llm-server parity): submits go
     # through the router's prefill/decode split; GET /debug/disagg
     router = getattr(engine, "disagg_router", None)
@@ -175,7 +180,13 @@ def build_app(**kw) -> App:
                        top_k: int = 0, ctx=None):
         # ctx threads the caller's trace context through to the engine so
         # the flight recorder's engine child spans (queue/prefill/decode)
-        # share the inbound trace id
+        # share the inbound trace id. QoS class/tenant come from the
+        # request headers (the OpenAI body shape has no field for them);
+        # unknown class strings 400 inside submit (tpu/qos.py)
+        qos_class = (ctx.request.header("X-QoS-Class") or None
+                     if ctx is not None else None)
+        tenant = (str(ctx.request.header("X-Tenant") or "")
+                  if ctx is not None else "")
         try:
             return submitter.submit(
                 prompt_tokens, max_new_tokens=max_tokens,
@@ -184,7 +195,8 @@ def build_app(**kw) -> App:
                 span=ctx.span if ctx is not None else None,
                 traceparent=(ctx.request.traceparent
                              if ctx is not None else None),
-                min_tokens=min_tokens, top_p=top_p, top_k=top_k)
+                min_tokens=min_tokens, top_p=top_p, top_k=top_k,
+                qos_class=qos_class, tenant=tenant)
         except ValueError:
             raise
         except Exception as exc:  # noqa: BLE001 - sheds → 503 + Retry-After

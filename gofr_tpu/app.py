@@ -448,6 +448,88 @@ class App:
         install_routes(self, burn, incidents, slo_path, incidents_path)
         return burn, incidents
 
+    def enable_qos(self, engine, burn=None, path: str = "/debug/qos"):
+        """Wire the QoS serving plane (tpu/qos.py) onto an engine:
+        tenant classes mapped onto priority bands, per-class deadline
+        budgets and slot/page quotas, and the burn-actuated shed ladder
+        (park batch -> preempt batch with replay -> 503 standard) that
+        finally makes the SLOBurnEngine ACT. When the app's pub/sub
+        broker is configured (PUBSUB_BACKEND) a batch lane consumes
+        offline jobs into the engine's batch band, with a cron drain
+        kick, so duty-cycle stays high between interactive bursts.
+
+        burn defaults to the engine recorder's burn engine (set by
+        enable_incident_autopsy — call that FIRST); without one the
+        ladder never escalates but classes/quotas/deadlines still apply.
+
+        Config: QOS_INTERACTIVE_RESERVED_SLOTS (slots the ladder keeps
+        free of non-interactive admissions, 1), QOS_BATCH_PAGE_FRACTION
+        (KV-page share batch may hold, 0.5), QOS_DEADLINE_{INTERACTIVE,
+        STANDARD,BATCH}_S (queue deadline budgets, 0 = off),
+        QOS_SHED_TRACKS (burn tracks the ladder watches, "ttft,tpot"),
+        QOS_ESCALATE_HOLD_S / QOS_RECOVER_HOLD_S (ladder dwells, 5/10),
+        QOS_EVAL_S (ladder eval cadence, 1.0), QOS_SHED_RETRY_AFTER_S
+        (Retry-After on ladder 503s, 2.0); QOS_LANE (batch lane on/off,
+        true), QOS_BATCH_TOPIC / QOS_BATCH_RESULT_TOPIC
+        (qos.batch.jobs / qos.batch.results), QOS_LANE_MAX_INFLIGHT (4),
+        QOS_LANE_CRON (drain-kick cron spec, every minute). Returns the
+        QoSController."""
+        from .tpu.qos import (BatchLane, QoSController, install_routes,
+                              register_qos_metrics)
+
+        cfg = self.config
+        metrics = self.container.metrics_manager
+        if metrics is not None:
+            register_qos_metrics(metrics)
+        tracks = [t.strip() for t in cfg.get_or_default(
+            "QOS_SHED_TRACKS", "ttft,tpot").split(",") if t.strip()]
+        controller = QoSController(
+            interactive_reserved_slots=cfg.get_int(
+                "QOS_INTERACTIVE_RESERVED_SLOTS", 1),
+            batch_page_fraction=cfg.get_float("QOS_BATCH_PAGE_FRACTION",
+                                              0.5),
+            deadlines={
+                "interactive": cfg.get_float("QOS_DEADLINE_INTERACTIVE_S",
+                                             0.0),
+                "standard": cfg.get_float("QOS_DEADLINE_STANDARD_S", 0.0),
+                "batch": cfg.get_float("QOS_DEADLINE_BATCH_S", 0.0)},
+            shed_tracks=tuple(tracks),
+            escalate_hold_s=cfg.get_float("QOS_ESCALATE_HOLD_S", 5.0),
+            recover_hold_s=cfg.get_float("QOS_RECOVER_HOLD_S", 10.0),
+            retry_after_s=cfg.get_float("QOS_SHED_RETRY_AFTER_S", 2.0),
+            metrics=metrics, logger=self.logger,
+            recorder=getattr(engine, "recorder", None))
+        if burn is None:
+            burn = getattr(getattr(engine, "recorder", None), "burn", None)
+        controller.use_burn_engine(burn)
+        controller.engine = engine
+        engine.qos = controller
+        controller.start_eval_loop(cfg.get_float("QOS_EVAL_S", 1.0))
+        self.on_shutdown(lambda: controller.stop())
+        # scrape-time re-evaluation, same contract as the burn engine:
+        # the ladder must RECOVER while the server is idle
+        self.container.add_scrape_hook("qos", controller.publish)
+        broker = getattr(self.container, "pubsub", None)
+        if cfg.get_bool("QOS_LANE", True) and broker is not None:
+            lane = BatchLane(
+                engine, broker,
+                topic=cfg.get_or_default("QOS_BATCH_TOPIC",
+                                         "qos.batch.jobs"),
+                result_topic=cfg.get_or_default("QOS_BATCH_RESULT_TOPIC",
+                                                "qos.batch.results"),
+                tokenizer=getattr(engine, "tokenizer", None),
+                max_inflight=cfg.get_int("QOS_LANE_MAX_INFLIGHT", 4),
+                metrics=metrics, logger=self.logger,
+                controller=controller)
+            controller.lane = lane
+            lane.start()
+            self.on_shutdown(lambda: lane.stop())
+            self.add_cron_job(
+                cfg.get_or_default("QOS_LANE_CRON", "* * * * *"),
+                "qos-batch-lane-drain", lane.cron_drain)
+        install_routes(self, controller, path)
+        return controller
+
     # -- cross-cutting registrations ------------------------------------------
     def add_http_service(self, name: str, address: str, *options) -> None:
         from .service import new_http_service

@@ -7,6 +7,8 @@ Counters
   app_tpu_fleet_retries_total{reason}        unstarted re-attempts
                                              (shed | connect_error | breaker_open)
   app_tpu_fleet_stream_breaks_total{replica} committed streams that died upstream
+  app_tpu_fleet_class_routes_total{class}    committed routes by QoS class
+  app_tpu_fleet_class_sheds_total{class}     replica 503 sheds by QoS class
 
 Gauges (published by the registry probe loop)
   app_tpu_fleet_replica_state{replica}       2=UP 1=DEGRADED/shedding 0=DOWN/open
@@ -28,6 +30,10 @@ def register_fleet_metrics(metrics):
          "Unstarted requests re-attempted on another replica, by reason"),
         ("app_tpu_fleet_stream_breaks_total",
          "Committed streams that died upstream (surfaced, never retried)"),
+        ("app_tpu_fleet_class_routes_total",
+         "Requests committed to a replica, by QoS class"),
+        ("app_tpu_fleet_class_sheds_total",
+         "Replica 503 sheds consumed by the retry loop, by QoS class"),
     ]
     gauges = [
         ("app_tpu_fleet_replica_state",

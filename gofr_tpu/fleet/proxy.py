@@ -20,6 +20,7 @@ import time
 
 from ..http.errors import InvalidParam, MissingParam, ServiceUnavailable
 from ..http.responder import Response, Stream
+from ..tpu.qos import normalize_class
 from ..service import CircuitOpenError
 from .affinity import (AffinityMap, DEFAULT_BLOCK, DEFAULT_MAX_BLOCKS,
                        affinity_keys)
@@ -53,6 +54,11 @@ class FleetRouter:
         self.affinity_misses = 0
         self.stream_breaks = 0
         self.no_replica = 0
+        # per-QoS-class accounting ("unclassified" for legacy traffic):
+        # routed = committed to a replica, shed = 503 replies consumed
+        # by the retry loop — the fleet-level view of replica shedding
+        self.class_routes = {}
+        self.class_sheds = {}
 
     @classmethod
     def from_config(cls, config, logger=None, metrics=None):
@@ -121,6 +127,12 @@ class FleetRouter:
             self.metrics.increment_counter("app_tpu_fleet_retries_total",
                                            reason=reason)
 
+    def _count_class(self, table, metric, qos_class):
+        cls = qos_class or "unclassified"
+        table[cls] = table.get(cls, 0) + 1
+        if self.metrics is not None:
+            self.metrics.increment_counter(metric, **{"class": cls})
+
     def _count_stream_break(self, replica):
         self.stream_breaks += 1
         replica.stream_breaks += 1
@@ -129,10 +141,13 @@ class FleetRouter:
                                            replica=replica.name)
 
     # -- forwarding -----------------------------------------------------------
-    def forward(self, ctx, body):
+    def forward(self, ctx, body, qos_class=None):
         """Route one /generate body; returns a Stream (SSE pass-through)
         or a Response (buffered pass-through), or raises
-        ServiceUnavailable when every attempt found no usable replica."""
+        ServiceUnavailable when every attempt found no usable replica.
+        qos_class (already normalized by the route handler) is counted
+        per class so fleet shedding/spillover is QoS-attributable; the
+        class itself rides inside `body`, which is forwarded verbatim."""
         prompt = body.get("prompt", "")
         keys = affinity_keys(prompt, self.affinity_block,
                              self.affinity_max_blocks)
@@ -169,8 +184,13 @@ class FleetRouter:
                 replica.end()
                 tried.add(replica.name)
                 self._count_retry("shed")
+                self._count_class(self.class_sheds,
+                                  "app_tpu_fleet_class_sheds_total",
+                                  qos_class)
                 continue
             # committed to this replica from here on — no more retries
+            self._count_class(self.class_routes,
+                              "app_tpu_fleet_class_routes_total", qos_class)
             if resp.status_code >= 400:
                 content = resp.read()
                 replica.end()
@@ -243,6 +263,8 @@ class FleetRouter:
             "routes": dict(self.routes),
             "routes_total": total_routes,
             "retries": dict(self.retries),
+            "classes": {"routes": dict(self.class_routes),
+                        "sheds": dict(self.class_sheds)},
             "no_replica": self.no_replica,
             "stream_breaks": self.stream_breaks,
             "affinity": {
@@ -279,7 +301,18 @@ def install_routes(app, router):
             raise MissingParam(["prompt"])
         if not isinstance(prompt, str) or not prompt:
             raise InvalidParam(["prompt"])
-        return router.forward(ctx, body)
+        # QoS class from header or body, validated AT THE FRONT DOOR
+        # (typed 400 for unknown strings — tpu/qos.py contract) and
+        # injected into the forwarded body so every replica sees the
+        # same classification the router counted
+        qos_class = normalize_class(
+            ctx.request.header("X-QoS-Class") or body.get("class") or None)
+        if qos_class is not None:
+            body["class"] = qos_class
+        tenant = ctx.request.header("X-Tenant") or body.get("tenant")
+        if tenant:
+            body["tenant"] = str(tenant)
+        return router.forward(ctx, body, qos_class=qos_class)
 
     from .debug import install_routes as install_debug_routes
     install_debug_routes(app, router)

@@ -142,7 +142,11 @@ def _spec(request) -> dict:
             "max_new": request.max_new_tokens, "temp": request.temperature,
             "stop": sorted(request.stop_tokens), "prio": request.priority,
             "min": request.min_tokens, "top_p": request.top_p,
-            "top_k": request.top_k}
+            "top_k": request.top_k,
+            # QoS identity rides the wave so follower shadows account
+            # classes identically; prio already carries the band
+            "qos": getattr(request, "qos_class", None),
+            "tenant": getattr(request, "tenant", "")}
 
 
 class AdmissionPlane:
@@ -282,7 +286,8 @@ class AdmissionPlane:
             spec["prompt"], max_new_tokens=spec["max_new"],
             temperature=spec["temp"], stop_tokens=set(spec["stop"]),
             priority=spec["prio"], min_tokens=spec["min"],
-            top_p=spec["top_p"], top_k=spec["top_k"])
+            top_p=spec["top_p"], top_k=spec["top_k"],
+            qos_class=spec.get("qos"), tenant=spec.get("tenant", ""))
         # the leader's id keeps (priority, id) heap order bit-identical
         request.id = spec["id"]
         if self.on_shadow is None:

@@ -99,6 +99,10 @@ def encode_handoff(request, blobs: Optional[Sequence[PageBlob]],
         "min": request.min_tokens,
         "top_p": request.top_p,
         "top_k": request.top_k,
+        # QoS identity crosses the hop for accounting; prio already
+        # carries the band and hand-offs outrank everything anyway
+        "qos": getattr(request, "qos_class", None),
+        "tenant": getattr(request, "tenant", ""),
     }
     traceparent = request.traceparent or _span_traceparent(request.gen_span)
     return json.dumps({
@@ -397,7 +401,9 @@ class DecodeCoordinator:
                 traceparent=body.get("traceparent"),
                 out_queue=preq.out_queue,
                 cancelled=preq.cancelled,
-                blobs=blobs)
+                blobs=blobs,
+                qos_class=spec.get("qos"),
+                tenant=spec.get("tenant", ""))
             self.consumed_total += 1
         except Exception as exc:  # noqa: BLE001
             # decode pool refused outright (draining/shedding/never-fits):
@@ -456,16 +462,21 @@ class DisaggRouter:
                max_new_tokens: int = 128, temperature: float = 0.0,
                stop_tokens=None, span=None, priority: int = 0,
                min_tokens: int = 0, top_p: float = 0.0, top_k: int = 0,
-               traceparent: Optional[str] = None):
+               traceparent: Optional[str] = None,
+               qos_class: Optional[str] = None, tenant: str = ""):
         """engine.submit()'s signature, against the split pair. Returns
-        the request whose stream() carries the whole generation."""
+        the request whose stream() carries the whole generation.
+        qos_class/tenant hit the PREFILL engine's QoS gate (banding,
+        ladder door check); the dead-pool fallback carries them through
+        for accounting only, like any hand-off."""
         with self._submit_gate:
             if self.worker.alive:
                 preq = self.prefill_engine.submit(
                     prompt_tokens, max_new_tokens=max_new_tokens,
                     temperature=temperature, stop_tokens=stop_tokens,
                     span=span, priority=priority, min_tokens=min_tokens,
-                    top_p=top_p, top_k=top_k, traceparent=traceparent)
+                    top_p=top_p, top_k=top_k, traceparent=traceparent,
+                    qos_class=qos_class, tenant=tenant)
                 with self._lock:
                     self._registry[preq.id] = [preq, "prefill", 0.0]
                 return preq
@@ -477,7 +488,7 @@ class DisaggRouter:
             temperature=temperature, stop_tokens=stop_tokens,
             priority=priority, min_tokens=min_tokens,
             top_p=top_p, top_k=top_k, traceparent=traceparent,
-            blobs=None)
+            blobs=None, qos_class=qos_class, tenant=tenant)
 
     def stats(self) -> Dict[str, Any]:
         """/debug/disagg payload: the hand-off plane's health plus both
@@ -536,7 +547,8 @@ class DisaggRouter:
                 traceparent=preq.traceparent
                 or _span_traceparent(preq.gen_span),
                 out_queue=preq.out_queue, cancelled=preq.cancelled,
-                blobs=None)
+                blobs=None, qos_class=getattr(preq, "qos_class", None),
+                tenant=getattr(preq, "tenant", ""))
         except Exception as exc:  # noqa: BLE001
             preq.error = exc
             preq.out_queue.put(None)

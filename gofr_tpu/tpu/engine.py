@@ -50,6 +50,7 @@ from ..models.llama import (LlamaConfig, init_kv_cache_layers,
                             llama_prefill_last, params_nbytes)
 from .executor import Executor, next_bucket
 from .obs import MetricsHook
+from . import qos
 from .sampling import pack_controls, sample_tokens, temperature_of
 from .stepledger import StepLedger
 from .utilization import UtilizationLedger
@@ -119,8 +120,16 @@ class GenerationRequest:
                  temperature: float = 0.0, stop_tokens: Optional[Set[int]] = None,
                  span=None, priority: int = 0, min_tokens: int = 0,
                  top_p: float = 0.0, top_k: int = 0,
-                 traceparent: Optional[str] = None):
+                 traceparent: Optional[str] = None,
+                 qos_class: Optional[str] = None, tenant: str = ""):
         self.id = next(_request_ids)
+        # QoS serving plane (tpu/qos.py): canonical class name or None for
+        # legacy/unclassified traffic, plus the tenant id for accounting.
+        # The class is already folded into `priority` (banded) by submit;
+        # it rides here so admission quotas, preemption targeting and
+        # per-class goodput can see it without reverse-engineering bands
+        self.qos_class = qos_class
+        self.tenant = tenant
         # admission priority: LOWER admits first; ties resolve FIFO by id.
         # Purely host-side — it reorders which queued request gets the next
         # free slot, never touching running generations
@@ -172,6 +181,11 @@ class GenerationRequest:
         # device-reset re-admissions consumed (bounded by the engine's
         # retry_budget; crossing it fails the request instead)
         self.replays = 0
+        # QoS preemptions survived (shed-ladder level 2): each one reuses
+        # the replay machinery — evacuate the slot, requeue at
+        # prompt+emitted — but is counted separately and does NOT consume
+        # the crash-recovery retry_budget
+        self.preemptions = 0
         # disaggregated serving (tpu/disagg.py): True on requests admitted
         # through submit_handoff — their prefill (and first token) already
         # happened on the prefill pool. handoff_blobs holds the shipped
@@ -681,6 +695,10 @@ class LLMEngine:
         # and IncidentManager.trigger never blocks the loop (captures run
         # on a daemon thread)
         self.incidents = None
+        # QoS serving plane (tpu/qos.py): None unless App.enable_qos wires
+        # a QoSController — same zero-overhead contract as the planes
+        # above (one attribute check per submit / admission round)
+        self.qos = None
         # crash-only recovery: replay-after-reset budget + reset-storm
         # breaker (tpu/faults.py). Active requests survive a device reset
         # by re-admitting at prompt+emitted with elevated priority; the
@@ -702,6 +720,7 @@ class LLMEngine:
         self.replays_total = 0
         self.replayed_tokens_total = 0
         self.quarantined_total = 0
+        self.preemptions_total = 0
         self._batch_seq = itertools.count(1)
         # chunked prefill (opt-in, 0 = off): prompts in buckets larger than
         # this are admitted as several bounded chunk dispatches, so decode
@@ -982,14 +1001,23 @@ class LLMEngine:
                span=None, priority: int = 0,
                min_tokens: int = 0, top_p: float = 0.0,
                top_k: int = 0,
-               traceparent: Optional[str] = None) -> GenerationRequest:
+               traceparent: Optional[str] = None,
+               qos_class: Optional[str] = None,
+               tenant: str = "") -> GenerationRequest:
         """priority: LOWER admits first when slots are contended (ties stay
-        FIFO); running generations are never preempted. min_tokens: stop
-        tokens are ignored until this many tokens have been emitted.
-        top_p/top_k truncate the sampled distribution per request (0 =
-        off) — only on engines built with sampling_controls=True.
-        traceparent: the caller's raw W3C header, for engine child spans
-        when no live span object is passed."""
+        FIFO); running generations are never preempted — except batch-class
+        requests under the QoS shed ladder, which preempt WITH replay (the
+        client stream pauses, nothing is lost). min_tokens: stop tokens
+        are ignored until this many tokens have been emitted. top_p/top_k
+        truncate the sampled distribution per request (0 = off) — only on
+        engines built with sampling_controls=True. traceparent: the
+        caller's raw W3C header, for engine child spans when no live span
+        object is passed. qos_class: 'interactive'/'standard'/'batch'
+        (tpu/qos.py) maps the request onto a priority band and subjects it
+        to class quotas/deadlines; None keeps legacy semantics untouched.
+        Unknown class strings are rejected with a typed 400, never
+        silently defaulted."""
+        qos_class = qos.normalize_class(qos_class)
         if self._stop.is_set():
             raise RuntimeError("engine is stopped")
         if self._draining:
@@ -1025,10 +1053,17 @@ class LLMEngine:
         if len(prompt_tokens) > limit:
             raise ValueError(f"prompt of {len(prompt_tokens)} tokens exceeds the "
                              f"admission limit ({limit})")
+        if self.qos is not None:
+            # shed-ladder door check (level 3 sheds standard with 503 +
+            # Retry-After); then fold the class into the admission
+            # priority band. Unclassified requests pass through unbanded
+            self.qos.check_submit(qos_class, tenant)
+            priority = qos.banded_priority(qos_class, priority)
         request = GenerationRequest(prompt_tokens, max_new_tokens, temperature,
                                     stop_tokens, span=span, priority=priority,
                                     min_tokens=min_tokens, top_p=top_p,
-                                    top_k=top_k, traceparent=traceparent)
+                                    top_k=top_k, traceparent=traceparent,
+                                    qos_class=qos_class, tenant=tenant)
         if self.tracer is not None:
             request.gen_span = self.tracer.start_span(
                 "tpu.generate", parent=span, traceparent=traceparent)
@@ -1037,6 +1072,8 @@ class LLMEngine:
         if self.recorder is not None:  # after gen_span: it carries the
             self.recorder.record_enqueued(request)  # inbound trace ctx
         self._obs.counter("app_tpu_requests_total")
+        if self.qos is not None:
+            self.qos.note_submitted(request)
         self._pending.put((request.priority, request.id, request))
         if self._stop.is_set():
             # stop() may have drained _pending between the check above and
@@ -1058,7 +1095,8 @@ class LLMEngine:
                        top_p: float = 0.0, top_k: int = 0,
                        traceparent: Optional[str] = None,
                        out_queue=None, cancelled=None,
-                       blobs=None) -> GenerationRequest:
+                       blobs=None, qos_class: Optional[str] = None,
+                       tenant: str = "") -> GenerationRequest:
         """Admit a generation whose prefill (and first token) already ran
         on another engine — the decode half of disaggregated serving
         (tpu/disagg.py), built on the replay-after-reset contract: the
@@ -1114,11 +1152,17 @@ class LLMEngine:
         # hand-offs outrank queued fresh arrivals (LOWER admits first,
         # clients are clamped >= 0), mirroring replay: the prompt's
         # prefill was already paid for and its client is mid-stream
+        # qos_class/tenant ride through for accounting only — no
+        # re-banding: the prefill side already applied class banding and
+        # a hand-off outranks everything regardless (its client is
+        # mid-stream, same rule as replay)
         request = GenerationRequest(prompt_tokens, max_new_tokens,
                                     temperature, stop_tokens,
                                     priority=min(int(priority), -1),
                                     min_tokens=min_tokens, top_p=top_p,
-                                    top_k=top_k, traceparent=traceparent)
+                                    top_k=top_k, traceparent=traceparent,
+                                    qos_class=qos.normalize_class(qos_class),
+                                    tenant=tenant)
         request.disagg_handoff = True
         request.handoff_blobs = blobs
         request.generated = len(emitted)
@@ -1947,6 +1991,13 @@ class LLMEngine:
                 if self.breaker.probe_due():
                     self._breaker_probe()
                 with self._state_lock:
+                    if self.qos is not None and self._plane is None:
+                        # act on the QoS shed ladder BEFORE admission so
+                        # slots freed by a preemption admit this round.
+                        # Single-controller only: under an AdmissionPlane
+                        # a local preemption would fork the wave replay
+                        with steps.seg("qos"):
+                            self._qos_actuate()
                     with steps.seg("admission"):
                         self._admit()
                     # one chunk per iteration: decode dispatches below and
@@ -2200,6 +2251,31 @@ class LLMEngine:
                 self._abort_admission(request)
                 self._fail_request(request)
                 continue
+            if self.qos is not None and self._plane is None:
+                # class gates (tpu/qos.py): deadline expiry fails the
+                # request before it ever costs a prefill; quota/ladder
+                # parks obey the heap's no-leapfrog rule — the entry
+                # goes back and the round stops, exactly like a page
+                # wait, so admission order stays strict within a band
+                decision = self.qos.admission_decision(request, self,
+                                                       taken=len(taken))
+                if decision == "expire":
+                    self._abort_admission(request)
+                    self.qos.note_expired(request)
+                    if self.recorder is not None:
+                        self.recorder.record_event(
+                            request.id, "qos_expired",
+                            waited_s=round(time.monotonic()
+                                           - request.enqueued_at, 2))
+                    self._fail_request(request, qos.QoSDeadlineError(
+                        qos.effective_class(request),
+                        time.monotonic() - request.enqueued_at,
+                        self.qos.deadlines.get(
+                            qos.effective_class(request), 0.0)))
+                    continue
+                if decision == "park":
+                    heapq.heappush(self._admission_heap, entry)
+                    break
             if not self._admission_ready(request):
                 heapq.heappush(self._admission_heap, entry)  # stays parked
                 break
@@ -2217,6 +2293,10 @@ class LLMEngine:
             handed = [r for r in taken if r.handoff_blobs is not None]
             if handed:
                 taken = [r for r in taken if r.handoff_blobs is None]
+
+        if self.qos is not None:
+            for request in itertools.chain(taken, handed):
+                self.qos.note_admitted(request)
 
         # group by admission bucket (the paged engine's prefix cache may
         # shrink a request's window to its un-cached tail), then split
@@ -2770,6 +2850,8 @@ class LLMEngine:
                       else ("cancelled" if request.cancelled.is_set()
                             else "aborted")))
         if not handled:
+            if self.qos is not None:
+                self.qos.note_finished(request, ok=request.error is None)
             request.out_queue.put(None)
 
     def _emit_block(self, request: GenerationRequest,
@@ -2911,6 +2993,8 @@ class LLMEngine:
                 request.gen_span.end()
             if self.recorder is not None:
                 self.recorder.record_finished(request, reason)
+            if self.qos is not None:
+                self.qos.note_finished(request, ok=request.error is None)
             self._obs.gauge("app_tpu_active_slots", active_now)
             request.out_queue.put(None)
         return job
@@ -3055,6 +3139,96 @@ class LLMEngine:
             heapq.heappush(self._admission_heap,
                            (request.priority, request.id, request))
         self._wake.set()
+
+    def _qos_actuate(self) -> None:
+        """Act on the QoS shed ladder (tpu/qos.py) from the engine loop,
+        under the state lock, immediately before admission. Level >= 2
+        (preempt_batch) evacuates running batch-class generations via the
+        replay contract so the slots (and, paged, their pages) free for
+        the interactive work the ladder is protecting. Levels 0/1/3 need
+        no loop-side action: parking and standard-shed happen at the
+        admission gate and the submit door."""
+        if self.qos.level < 2:
+            return
+        self._preempt_slots(("batch",))
+
+    def _preempt_slots(self, classes) -> int:
+        """Preempt every running generation in `classes` that can legally
+        resume: evacuate the slot WITHOUT terminating (no out_queue
+        sentinel, no span end — the reset-survivor recipe) and requeue at
+        prompt + emitted with the request's OWN banded priority, so a
+        preempted batch request waits behind interactive work instead of
+        outranking it the way crash replays do. Zero client-visible loss:
+        the stream pauses, nothing is re-emitted or dropped. In-flight
+        dispatches that still reference the slot are discarded by the
+        same `slot.request is not request` guards that make cancel+free
+        safe. Skips: chunked-mid-prefill slots (nothing emitted yet and
+        the chunk job owns the slot), exhausted budgets, resume windows
+        over the admission limit, and prefill-pool slots (they evacuate
+        at prefill sync anyway). Returns the number preempted."""
+        import heapq
+
+        preempted = 0
+        for slot in self.slots:
+            request = slot.request
+            if request is None or slot.chunking is not None:
+                continue
+            if getattr(request, "qos_class", None) not in classes:
+                continue
+            if self._is_cancelled(request):
+                continue  # the demux finish path owns cancellation
+            if request.max_new_tokens - request.generated <= 0:
+                continue  # about to finish naturally; let it
+            if len(request.resume_tokens) > self.admission_limit:
+                continue  # could never re-admit; finishing is cheaper
+            if self.disagg_role == "prefill":
+                continue
+            self._release_slot_for_preempt(slot)
+            request.preemptions += 1
+            request.admitted_at = None  # re-stamped at re-admission
+            self.preemptions_total += 1
+            preempted += 1
+            self._obs.counter("app_tpu_qos_preempted_total",
+                              **{"class": request.qos_class})
+            self.qos.note_preempted(request)
+            if self.recorder is not None:
+                self.recorder.record_event(
+                    request.id, "preempted",
+                    emitted=len(request.emitted),
+                    preemptions=request.preemptions)
+            heapq.heappush(self._admission_heap,
+                           (request.priority, request.id, request))
+        if preempted:
+            if self.recorder is not None:
+                self.recorder.record_engine_event(
+                    "qos_preempt", preempted=preempted,
+                    level=self.qos.level)
+            if self.logger is not None:
+                self.logger.warnf(
+                    "qos ladder level %d: preempted %d batch generation(s) "
+                    "for replay", self.qos.level, preempted)
+            self._obs.gauge("app_tpu_active_slots",
+                            sum(1 for s in self.slots if s.active))
+        return preempted
+
+    def _release_slot_for_preempt(self, slot: _Slot) -> None:
+        """Evacuate one slot for preemption: the reset-survivor recipe
+        (request lives on, stream stays open) plus the freed-row control
+        zeroing from _finish_slot. Paged engines override to release the
+        slot's pages first — unlike a device reset, the allocator is NOT
+        rebuilt, so pages must be returned explicitly."""
+        request = slot.request
+        slot.request = None
+        slot.length = 0
+        slot.remaining = 0
+        slot.history = None
+        slot.pages = None
+        if (self.sampling_controls and request is not None
+                and (request.top_p or request.top_k)):
+            idx = next((i for i, s in enumerate(self.slots) if s is slot),
+                       None)
+            if idx is not None:
+                self._temps = self._temps.at[idx].set(0.0)
 
     def _is_cancelled(self, request: GenerationRequest) -> bool:
         """Cancellation as the DISPATCH path must see it. Single-controller:

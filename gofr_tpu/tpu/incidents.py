@@ -302,6 +302,19 @@ class SLOBurnEngine:
             paged = self._recompute_locked(self._clock())
         self._fire(paged)
 
+    def states(self) -> Dict[str, str]:
+        """Current alert state per SLO track ("ok"/"warn"/"page"), fresh:
+        re-evaluates first so burn decays toward ok even when nothing
+        completes and nothing scrapes. This is the probe the QoS shed
+        ladder (tpu/qos.py) actuates on — the point where the burn
+        engine stops being a read-only pager."""
+        with self._lock:
+            paged = self._recompute_locked(self._clock())
+            out = {name: STATE_LABELS[track.state]
+                   for name, track in self._tracks.items()}
+        self._fire(paged)
+        return out
+
     def peaks(self) -> Dict[str, Dict[str, float]]:
         """Max burn rate observed per SLO/window (soak artifacts)."""
         with self._lock:

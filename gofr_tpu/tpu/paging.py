@@ -231,7 +231,8 @@ class PagedLLMEngine(LLMEngine):
                temperature: float = 0.0, stop_tokens=None,
                span=None, priority: int = 0,
                min_tokens: int = 0, top_p: float = 0.0,
-               top_k: int = 0, traceparent=None) -> GenerationRequest:
+               top_k: int = 0, traceparent=None,
+               qos_class=None, tenant: str = "") -> GenerationRequest:
         """Reject requests whose reservation could NEVER fit the pool:
         parking them would permanently occupy the admission heap's head
         for their priority class behind an allocation that cannot
@@ -247,7 +248,8 @@ class PagedLLMEngine(LLMEngine):
         return super().submit(prompt_tokens, max_new_tokens, temperature,
                               stop_tokens, span=span, priority=priority,
                               min_tokens=min_tokens, top_p=top_p,
-                              top_k=top_k, traceparent=traceparent)
+                              top_k=top_k, traceparent=traceparent,
+                              qos_class=qos_class, tenant=tenant)
 
     def submit_handoff(self, prompt_tokens, emitted, **kw):
         """submit()'s never-fits rejection, applied to the hand-off path:
@@ -385,6 +387,15 @@ class PagedLLMEngine(LLMEngine):
         else:
             self.allocator.release(slot.pages)
         slot.pages = None
+
+    def _release_slot_for_preempt(self, slot) -> None:
+        """QoS preemption on a paged engine: unlike a device reset (which
+        rebuilds the whole allocator), the pool survives — so this slot's
+        pages must be returned explicitly before the evacuation, exactly
+        like the finish path (prefix-owned pages stay cache-resident, so
+        the preempted request's re-prefill will mostly be a prefix hit)."""
+        self._release_slot_pages(slot)
+        super()._release_slot_for_preempt(slot)
 
     def _finish_slot(self, slot) -> None:
         self._release_slot_pages(slot)

@@ -18,19 +18,26 @@ from typing import Callable, Optional, Sequence
 
 from .executor import Executor, next_bucket, pad_to
 from .obs import MetricsHook
+from .qos import normalize_class
 
 BATCH_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256)
 
 
 class _WorkItem:
-    __slots__ = ("payload", "future", "enqueued_at")
+    __slots__ = ("payload", "future", "enqueued_at", "qos_class", "tenant")
 
-    def __init__(self, payload):
+    def __init__(self, payload, qos_class=None, tenant=""):
         self.payload = payload
         self.future: Future = Future()
         # monotonic like the engine's request stamps: TTFT math must not
         # bend under an NTP step
         self.enqueued_at = time.monotonic()
+        # QoS accounting (tpu/qos.py): the batcher assembles batches FIFO
+        # (no class reordering — items share one padded dispatch), but
+        # the class still rides along validated so mixed surfaces report
+        # per-class latency consistently with the engine path
+        self.qos_class = qos_class
+        self.tenant = tenant
 
 
 class DynamicBatcher:
@@ -73,9 +80,12 @@ class DynamicBatcher:
         self._thread: Optional[threading.Thread] = None
 
     # -- ingress --------------------------------------------------------------
-    def submit(self, payload) -> Future:
+    def submit(self, payload, qos_class=None, tenant: str = "") -> Future:
         if self._stop.is_set():
             raise RuntimeError("batcher is stopped")
+        # unknown class strings die here with a typed 400 (InvalidParam),
+        # never a silent default — same contract as engine.submit
+        qos_class = normalize_class(qos_class)
         if self.seq_axis is not None and hasattr(payload, "shape"):
             # reject oversized payloads here so one bad request can't fail
             # the whole co-assembled batch in _run_batch
@@ -83,7 +93,7 @@ class DynamicBatcher:
             if seq_len > self.seq_buckets[-1]:
                 raise ValueError(f"sequence of {seq_len} exceeds the largest "
                                  f"bucket ({self.seq_buckets[-1]})")
-        item = _WorkItem(payload)
+        item = _WorkItem(payload, qos_class=qos_class, tenant=tenant)
         self._queue.put(item)
         self._obs.gauge("app_tpu_queue_depth", self._queue.qsize())
         return item.future

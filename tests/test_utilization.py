@@ -319,11 +319,16 @@ def test_metric_inventory_consistency():
     # against gofr_tpu/fleet's recording style)
     assert any(n.startswith("app_tpu_fleet_") for n in recorded), \
         "fleet router counters vanished from the inventory scan"
+    # the QoS plane family must be IN the scan (guards regex rot against
+    # tpu/qos.py's recording style)
+    assert any(n.startswith("app_tpu_qos_") for n in recorded), \
+        "qos plane counters vanished from the inventory scan"
 
     from gofr_tpu.fleet import register_fleet_metrics
     from gofr_tpu.tpu.device import TPUClient
     from gofr_tpu.tpu.disagg import register_disagg_metrics
     from gofr_tpu.tpu.flightrecorder import register_slo_gauges
+    from gofr_tpu.tpu.qos import register_qos_metrics
     from gofr_tpu.tpu.stepledger import register_step_metrics
 
     manager = Manager()
@@ -335,6 +340,7 @@ def test_metric_inventory_consistency():
     register_step_metrics(manager)  # idempotent next to register_metrics
     register_disagg_metrics(manager)
     register_fleet_metrics(manager)
+    register_qos_metrics(manager)
     registered = set(manager._store)
     missing = recorded - registered
     assert not missing, (
@@ -376,7 +382,8 @@ def test_debug_endpoint_inventory_documented():
     # regex-rot guard: the known surfaces must all be in the scan
     for expected in ("/debug/profile", "/debug/requests", "/debug/engine",
                      "/debug/steps", "/debug/faults", "/debug/slo",
-                     "/debug/incidents", "/debug/disagg", "/debug/fleet"):
+                     "/debug/incidents", "/debug/disagg", "/debug/fleet",
+                     "/debug/qos"):
         assert expected in routes, f"scan missed {expected} (regex rot?)"
 
     docs = os.path.join(os.path.dirname(__file__), "..", "docs",

@@ -14,10 +14,13 @@ totals), the `/debug/steps` anatomy summary (per-phase step-time
 baselines, segment totals, recent stragglers), the `/debug/slo`
 burn-rate readout (per-SLO fast/slow burn + alert state — the paging
 signal), the `/debug/incidents` index (auto-captured evidence
-bundles + suppression counts), and — on split-serving deployments
-(DISAGG_MODE=both) — the `/debug/disagg` hand-off counters (queue
-depth, hand-offs, fallbacks), so soak artifacts gain efficiency,
-step-anatomy, and error-budget axes next to the tail evidence.
+bundles + suppression counts), on split-serving deployments
+(DISAGG_MODE=both) the `/debug/disagg` hand-off counters (queue
+depth, hand-offs, fallbacks), and — on QOS=true servers — the
+`/debug/qos` control-plane readout (shed-ladder level + transition
+trail, per-class queue/goodput/preemption counters, batch-lane depth),
+so soak artifacts gain efficiency, step-anatomy, error-budget, and
+QoS-control axes next to the tail evidence.
 
 Usage:
     python tools/obs_dump.py [--server http://127.0.0.1:8000]
@@ -170,6 +173,25 @@ def poll_once(server: str, metrics_base: str) -> dict:
         }
     except Exception as exc:  # noqa: BLE001 - only router-tier processes serve it
         entry["fleet_error"] = str(exc)
+    try:
+        body = json.loads(_get(server.rstrip("/") + "/debug/qos"))
+        snap = body.get("data", body)
+        # ladder + per-class counters carry the control-plane signal; the
+        # transition trail is bounded (deque) so it rides along whole
+        entry["qos"] = {
+            "ladder": snap.get("ladder"),
+            "quotas": snap.get("quotas"),
+            "preemptions_total": snap.get("preemptions_total"),
+            "classes": {
+                cls: {k: row.get(k) for k in (
+                    "queued", "active", "submitted", "finished", "errors",
+                    "shed", "preempted", "expired", "goodput",
+                    "ttft_p50_ms")}
+                for cls, row in (snap.get("classes") or {}).items()},
+            "lane": snap.get("lane"),
+        }
+    except Exception as exc:  # noqa: BLE001 - QOS=false servers lack the route
+        entry["qos_error"] = str(exc)
     try:
         entry["gauges"] = scrape_gauges(metrics_base)
     except Exception as exc:  # noqa: BLE001
