@@ -70,6 +70,13 @@ class _Handler(BaseHTTPRequestHandler):
     do_GET = do_POST = do_PUT = do_PATCH = do_DELETE = do_OPTIONS = do_HEAD = _dispatch
 
 
+class _Server(ThreadingHTTPServer):
+    # The socketserver default listen backlog is 5; a burst of simultaneous
+    # connects (concurrent SSE clients, fleet fan-out) overflows it on a busy
+    # host and the kernel RSTs connections before accept() ever sees them.
+    request_queue_size = 128
+
+
 class HTTPServer:
     def __init__(self, router: Router, port: int, logger=None, host: str = "0.0.0.0"):
         self.router = router
@@ -81,7 +88,7 @@ class HTTPServer:
 
     def start(self) -> None:
         handler = type("BoundHandler", (_Handler,), {"router": self.router, "logger": self.logger})
-        self._server = ThreadingHTTPServer((self.host, self.port), handler)
+        self._server = _Server((self.host, self.port), handler)
         self._server.daemon_threads = True
         if self.port == 0:
             self.port = self._server.server_address[1]

@@ -69,10 +69,10 @@ class InProcKV:
             self._cond.notify_all()
 
     def get_blocking(self, key: str, timeout_s: float) -> str:
-        deadline = time.time() + timeout_s
+        deadline = time.monotonic() + timeout_s
         with self._cond:
             while key not in self._data:
-                left = deadline - time.time()
+                left = deadline - time.monotonic()
                 if left <= 0:
                     raise TimeoutError(key)
                 self._cond.wait(timeout=left)
@@ -238,7 +238,7 @@ class AdmissionPlane:
         return drained, draining
 
     def _consume(self, has_work):
-        deadline = time.time() + self.wave_timeout_s
+        deadline = time.monotonic() + self.wave_timeout_s
         while True:
             try:
                 raw = self.kv.get_blocking(self._key(self._seq), 0.5)
@@ -254,7 +254,7 @@ class AdmissionPlane:
                     # (drain timeouts, stats). _seq is untouched, so the
                     # next call resumes waiting on the same wave.
                     return [], False
-                if time.time() > deadline:
+                if time.monotonic() > deadline:
                     # active work on every rank but no wave: the leader is
                     # gone or wedged — surface it instead of hanging the slice
                     raise RuntimeError(

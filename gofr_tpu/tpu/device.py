@@ -96,7 +96,7 @@ class TPUClient:
             self._devices = jax.devices(self.platform_override)
         else:
             self._devices = jax.devices()
-        self._connected_at = time.time()
+        self._connected_at = time.monotonic()
         if self.metrics is not None:
             self.register_metrics()
         if self.logger is not None:
@@ -345,7 +345,7 @@ class TPUClient:
             "platform": self.platform,
             "devices": len(self._devices),
             "memory": mem,
-            "uptime_s": round(time.time() - (self._connected_at or time.time()), 1),
+            "uptime_s": round(time.monotonic() - (self._connected_at or time.monotonic()), 1),
         })
 
     def close(self) -> None:

@@ -50,6 +50,7 @@ from ..models.llama import (LlamaConfig, init_kv_cache_layers,
                             llama_prefill_last, params_nbytes)
 from .executor import Executor, next_bucket
 from .obs import MetricsHook
+from .ownership import loop_only
 from . import qos
 from .sampling import pack_controls, sample_tokens, temperature_of
 from .stepledger import StepLedger
@@ -2082,6 +2083,7 @@ class LLMEngine:
                 slot.request.error = stop_exc
                 self._finish_slot(slot)
 
+    @loop_only
     def _note_compile(self, name: str, seconds: float) -> None:
         """Executor cache-miss callback: re-attribute compile time out of
         whatever step segment it elapsed under (tpu/stepledger.py). A
@@ -2089,6 +2091,7 @@ class LLMEngine:
         thread guard."""
         self.steps.note_stolen("compile", seconds)
 
+    @loop_only
     def _finish_step(self) -> None:
         """Close the step ledger's iteration record and surface a flagged
         straggler as a flight-recorder engine event carrying the dominant
@@ -2527,6 +2530,7 @@ class LLMEngine:
             except Exception:  # noqa: BLE001 - overlap is optional
                 pass
 
+    @loop_only
     def _fetch_host(self, *arrays) -> List[Any]:
         """Blocking device->host fetch that still overlaps the transfers
         with each other: start EVERY copy async first (the KV spill path
@@ -2854,6 +2858,7 @@ class LLMEngine:
                 self.qos.note_finished(request, ok=request.error is None)
             request.out_queue.put(None)
 
+    @loop_only
     def _emit_block(self, request: GenerationRequest,
                     tokens: List[int]) -> None:
         """Deliver one request's demuxed tokens for this sync in a SINGLE
@@ -3072,6 +3077,7 @@ class LLMEngine:
             self._init_device_state()
             self._replay_or_fail(survivors, exc)
 
+    @loop_only
     def _replay_or_fail(self, survivors: List[GenerationRequest],
                         exc: BaseException) -> None:
         """Requeue each reset survivor for replay, or fail it when it is
@@ -3265,6 +3271,7 @@ class LLMEngine:
         raise NotImplementedError(
             "page-blob KV export needs the paged engine")
 
+    @loop_only
     def _handoff_fallback(self, request: GenerationRequest,
                           reason: str) -> None:
         """A hand-off this pool cannot land (torn content, wrong shape,

@@ -115,7 +115,7 @@ class Executor:
     PRUNE_AGE_S = 30 * 86400
 
     def _prune_stale_artifacts(self) -> None:
-        now = time.time()
+        now = time.time()  # lint: clock-ok compared against file mtimes, which are wall-clock
         try:
             for fname in os.listdir(self.cache_dir):
                 if fname.endswith(".jexec"):
@@ -366,7 +366,7 @@ class Executor:
                 loaded = self._cache.setdefault(key, loaded)
             return loaded
 
-        start = time.time()
+        start = time.monotonic()
         kwargs: Dict[str, Any] = {}
         if static_argnums:
             kwargs["static_argnums"] = static_argnums
@@ -379,7 +379,7 @@ class Executor:
         jitted = jax.jit(fn, **kwargs)
         compiled = jitted.lower(*args).compile()
         program = CompiledProgram(compiled, name, key)
-        elapsed = time.time() - start
+        elapsed = time.monotonic() - start
         program.compile_seconds = elapsed
         self._save_to_disk(key, fn, compiled, dev_sig)
         with self._lock:
@@ -395,12 +395,12 @@ class Executor:
 
     def run(self, name: str, fn: Callable, *args, **compile_kwargs):
         program = self.compile(name, fn, args, **compile_kwargs)
-        start = time.time()
+        start = time.monotonic()
         out = program(*args)
         if self.metrics is not None:
             try:
                 self.metrics.increment_counter("app_tpu_execute_total")
-                self.metrics.record_histogram("app_tpu_execute_seconds", time.time() - start)
+                self.metrics.record_histogram("app_tpu_execute_seconds", time.monotonic() - start)
             except Exception:  # noqa: BLE001
                 pass
         return out

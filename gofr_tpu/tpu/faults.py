@@ -185,6 +185,7 @@ class FaultPlane:
                     rule = candidate
                     break
             if rule is not None:
+                # lint: clock-ok operator-facing fired-trail timestamp, correlated with external logs
                 self._fired.append({"t": time.time(), "site": site,
                                     "hit": count, "action": rule.action,
                                     **ctx})

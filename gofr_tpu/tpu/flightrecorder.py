@@ -73,7 +73,7 @@ class RequestRecord:
         self.enqueued_at = request.enqueued_at
         # wall/monotonic anchor: the ONE place both clocks are read
         # together; every displayed epoch is enqueue-wall + monotonic delta
-        self.wall0 = time.time()
+        self.wall0 = time.time()  # lint: clock-ok the designated wall/mono anchor pair
         self.mono0 = time.monotonic()
         self.admitted_at: Optional[float] = None
         self.first_token_at: Optional[float] = None
@@ -358,6 +358,7 @@ class FlightRecorder:
         try:
             with self._lock:
                 self._engine_events.append(
+                    # lint: clock-ok operator-facing event timestamp, correlated with external logs
                     {"t": time.time(), "event": name, **data})
             if self.burn is not None and name in ("stall_shed",
                                                   "breaker_shed"):

@@ -259,6 +259,7 @@ class SLOBurnEngine:
                         else None,
                         "burn_slow": round(slow, 3) if slow is not None
                         else None,
+                        # lint: clock-ok operator-facing transition timestamp (burn math itself uses the monotonic window clock)
                         "t": time.time()}
                 self._transitions.append(info)
                 if state == STATE_PAGE:
@@ -513,7 +514,7 @@ class IncidentManager:
             "id": incident_id,
             "trigger": kind,
             "context": ctx,
-            "captured_at": time.time(),
+            "captured_at": time.time(),  # lint: clock-ok incident bundles are correlated with external logs by wall time
         }
         engine = self.engine
         recorder = self.recorder or getattr(engine, "recorder", None)

@@ -1,0 +1,52 @@
+"""Thread-ownership markers: the `@loop_only` convention, formalized.
+
+PRs 4/6 scattered "loop-thread-only" comments across engine.py,
+stepledger.py, paging.py and prefixcache.py — true statements nothing
+enforced. `@loop_only` turns each comment into a machine-checkable
+contract: graftlint's ownership pass (tools/analysis/passes/ownership.py)
+verifies that marked methods — and writes to the instance fields they
+declare via ``fields=(...)`` — are only reached from loop-rooted call
+paths (functions named ``_loop`` or themselves marked ``@loop_only``).
+
+The decorator is deliberately zero-cost at runtime: it stamps two
+attributes and returns the function unwrapped, so the engine hot loop
+pays nothing. ``__init__`` is always exempt from field-ownership (the
+constructing thread owns the object before the loop exists); any other
+off-loop access is either a bug, a pragma with a reason, or a baselined
+finding — never silent.
+
+    class PageAllocator:
+        @loop_only(fields=("_free", "_refs"))
+        def alloc(self, n): ...
+
+A registry of every marked function is kept for introspection
+(`/debug`-style tooling, tests); it is not consulted on any hot path.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Tuple
+
+# qualname -> declared owned fields, for introspection and tests
+LOOP_ONLY_REGISTRY: Dict[str, Tuple[str, ...]] = {}
+
+
+def loop_only(fn: Optional[Callable] = None, *,
+              fields: Tuple[str, ...] = ()):
+    """Mark a function as engine-loop-thread-only. Usable bare
+    (``@loop_only``) or with owned fields
+    (``@loop_only(fields=("_slots",))``). Returns the function object
+    itself — no wrapper, no per-call overhead."""
+
+    def mark(f: Callable) -> Callable:
+        f.__loop_only__ = True
+        f.__loop_owned_fields__ = tuple(fields)
+        LOOP_ONLY_REGISTRY[f"{f.__module__}.{f.__qualname__}"] = \
+            tuple(fields)
+        return f
+
+    return mark(fn) if fn is not None else mark
+
+
+def is_loop_only(fn: Callable) -> bool:
+    return bool(getattr(fn, "__loop_only__", False))

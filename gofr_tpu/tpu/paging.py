@@ -34,6 +34,7 @@ from ..models.llama import LlamaConfig, llama_decode_step_paged, llama_prefill_l
 from ..ops.paged_attention import paged_write_prefill_stacked
 from .engine import (CacheLostError, GenerationRequest, LLMEngine,
                      _pin_standard_layout)
+from .ownership import loop_only
 
 
 class PageAllocator:
@@ -412,6 +413,7 @@ class PagedLLMEngine(LLMEngine):
         self._run_off_loop(flush)
 
     # -- tiered KV: spill on evict, restore on hit ----------------------------
+    @loop_only
     def _evict_prefix_pages(self, n: int) -> List[int]:
         """prefix.evict + KV spill: fetch the evicted pages' KV to the
         host (the async-D2H machinery) and hand the blobs to the tier
@@ -1358,6 +1360,7 @@ class PagedLLMEngine(LLMEngine):
                 self.prefix.insert(request.resume_tokens, slot.pages)
 
     # -- disaggregated hand-off (tpu/disagg.py) -------------------------------
+    @loop_only
     def _handoff_slot(self, slot, request) -> None:
         """Prefill-pool KV export: gather the slot's prompt pages to the
         host (the spill path's async-overlap D2H), wrap them as PageBlobs,

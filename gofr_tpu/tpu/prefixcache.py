@@ -35,6 +35,8 @@ from __future__ import annotations
 from collections import OrderedDict
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from .ownership import loop_only
+
 
 class PrefixCache:
     def __init__(self, page_size: int):
@@ -98,6 +100,8 @@ class PrefixCache:
         return self._keys_for(tokens, n_pages)
 
     # -- the serving protocol ------------------------------------------------
+    @loop_only(fields=("_entries", "_key_of_page", "_refs", "_parent",
+                       "_nchildren"))
     def match(self, tokens: Sequence[int]) -> List[int]:
         """Longest run of cached full pages from page 0, with at least one
         tail token left unmatched. Acquires a ref on every matched page
@@ -120,6 +124,7 @@ class PrefixCache:
         self.miss_pages += matchable - len(pages)
         return pages
 
+    @loop_only
     def insert(self, tokens: Sequence[int], table_pages: Sequence[int]) -> None:
         """Register a freshly-prefilled prompt's full pages. table_pages is
         the slot's page list in table order (shared prefix pages first);
@@ -148,6 +153,7 @@ class PrefixCache:
             prev_key = key
             self.inserted_pages += 1
 
+    @loop_only
     def unref(self, page_id: int) -> None:
         # a loud error, not assert: under python -O a silent negative ref
         # would make the page permanently fail the refs==0 eviction check —
@@ -157,6 +163,7 @@ class PrefixCache:
             raise RuntimeError(f"prefix page {page_id} over-released")
         self._refs[page_id] = refs
 
+    @loop_only
     def evict(self, n: int) -> List[int]:
         """Reclaim up to n LRU pages with no active refs AND no resident
         children (leaf-first: a chain evicts tail-inward, never stranding
@@ -164,6 +171,7 @@ class PrefixCache:
         list."""
         return [page_id for _, page_id, _ in self.evict_entries(n)]
 
+    @loop_only
     def evict_entries(self, n: int) -> List[Tuple[int, int, tuple]]:
         """evict() with full entry detail: (chain_key, page_id, tokens)
         per reclaimed page. The tiered KV cache needs all three to spill
@@ -195,6 +203,7 @@ class PrefixCache:
                 progress = True
         return freed
 
+    @loop_only
     def drop_all_idle(self) -> List[int]:
         """Evict every idle page (device-state reset path)."""
         return self.evict(len(self._entries))

@@ -68,7 +68,7 @@ def _run_capture(seconds: float, out: str) -> None:
                 time.monotonic() - started_mono, 3)
         if error is None:
             _state["last_dir"] = out
-            _state["last_captured_at"] = time.time()
+            _state["last_captured_at"] = time.time()  # lint: clock-ok operator-facing wall-clock timestamp in status()
 
 
 def start_capture(seconds: float, log_dir: str = "./profiles",
@@ -89,7 +89,7 @@ def start_capture(seconds: float, log_dir: str = "./profiles",
             raise RuntimeError("a profile capture is already running")
         _state["active"] = True
         _state["pending_dir"] = out
-        _state["started_at"] = time.time()
+        _state["started_at"] = time.time()  # lint: clock-ok operator-facing wall-clock timestamp in status()
         _state["started_mono"] = time.monotonic()
         _state["trigger"] = str(trigger)
         _state["seconds"] = seconds
@@ -112,8 +112,8 @@ def capture_trace(seconds: float, log_dir: str = "./profiles",
     """Blocking convenience wrapper around start_capture (scripts/tools):
     waits for the capture to finish and returns its trace dir."""
     out, bounded = start_capture(seconds, log_dir)
-    deadline = time.time() + bounded + 30.0
-    while time.time() < deadline:
+    deadline = time.monotonic() + bounded + 30.0
+    while time.monotonic() < deadline:
         with _lock:
             if not _state["active"]:
                 if _state["last_error"]:

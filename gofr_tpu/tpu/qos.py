@@ -317,7 +317,7 @@ class QoSController:
     def _set_level_locked(self, level: int, now: float,
                           states: Dict[str, str]) -> None:
         info = {"from": LEVEL_LABELS[self.level], "to": LEVEL_LABELS[level],
-                "level": level, "tracks": dict(states), "t": time.time()}
+                "level": level, "tracks": dict(states), "t": now}
         self._transitions.append(info)
         self.level = level
         self._level_since = now

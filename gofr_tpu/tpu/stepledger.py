@@ -91,6 +91,7 @@ import time
 from typing import Any, Dict, List, Optional
 
 from .obs import MetricsHook
+from .ownership import loop_only
 
 SEGMENTS = ("admission", "page_alloc", "kv_restore", "kv_handoff",
             "host_prep", "compile", "cache_grow", "dispatch", "device_sync",
@@ -269,6 +270,9 @@ class StepLedger:
         return (self._t0 is not None
                 and self._owner == threading.get_ident())
 
+    @loop_only(fields=("_owner", "_seq", "_t0", "_last_end", "_frames",
+                       "_segments", "_dispatches", "_sync_kind",
+                       "_tokens", "_slowest"))
     def step_start(self) -> None:
         """Open a step. The gap since the previous step's end (wake waits,
         anything outside the instrumented body) becomes idle_gap."""
@@ -310,6 +314,7 @@ class StepLedger:
         no step is open or on a foreign thread."""
         return self._Seg(self, name)
 
+    @loop_only
     def _pop_frame(self) -> None:
         name, started, child_s = self._frames.pop()
         dur = self._clock() - started
@@ -318,6 +323,7 @@ class StepLedger:
         if self._frames:
             self._frames[-1][2] += dur
 
+    @loop_only
     def note_stolen(self, name: str, seconds: float) -> None:
         """Re-attribute `seconds` already elapsing inside the current
         segment to `name` (the executor's compile callback: a cache-miss
@@ -328,10 +334,12 @@ class StepLedger:
         if self._frames:
             self._frames[-1][2] += seconds
 
+    @loop_only
     def note_dispatch(self, kind: str) -> None:
         if self._mine():
             self._dispatches[kind] = self._dispatches.get(kind, 0) + 1
 
+    @loop_only
     def note_sync(self, kind: str, tokens: int = 0,
                   slowest_request_id: Optional[int] = None) -> None:
         if self._mine():
@@ -340,6 +348,7 @@ class StepLedger:
             if slowest_request_id is not None:
                 self._slowest = slowest_request_id
 
+    @loop_only
     def step_abort(self) -> None:
         """Discard the open step (device-reset path): a step that died in
         an exception must not feed the baselines, but its time still
@@ -350,6 +359,7 @@ class StepLedger:
         self._t0 = None
         self._frames = []
 
+    @loop_only
     def step_end(self, active_slots: int = 0, inflight: int = 0,
                  queue_depth: int = 0) -> Optional[StepRecord]:
         """Close the step. Pure-bookkeeping iterations (no dispatch, no

@@ -1,0 +1,17 @@
+"""Fixture for the clock pass: parsed by graftlint, never imported."""
+
+import time
+from time import time as now_wall
+
+
+def deadline(timeout_s):
+    return time.time() + timeout_s         # FLAG: wall-clock deadline
+
+
+def aliased():
+    return now_wall()                      # FLAG: from-import alias
+
+
+def display_anchor():
+    t = time.time()  # lint: clock-ok display anchor for the fixture
+    return t, time.monotonic()             # monotonic: never flagged

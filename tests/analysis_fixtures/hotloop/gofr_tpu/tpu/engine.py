@@ -1,0 +1,34 @@
+"""Fixture for the hotloop pass: parsed by graftlint, never imported."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class Engine:
+    def _loop(self):
+        self._step()
+        self._helper()
+
+    def _step(self):
+        logits = jnp.argmax(self._x)       # device-producing assignment
+        n = float(logits)                  # FLAG: implicit __float__ sync
+        count = logits.item()              # FLAG: scalar pull
+        host = np.asarray(logits)          # FLAG: tainted asarray
+        ok = np.asarray([1, 2, 3])         # no flag: host literal
+        ids = np.asarray(list(range(4)))   # no flag: host call
+        return n, count, host, ok, ids
+
+    def _helper(self):
+        out = jax.device_get(self._x)      # FLAG
+        self._x.block_until_ready()        # FLAG
+        return out
+
+    def _sync_oldest(self):
+        # a root in its own right; the designated sync point is pragma'd
+        v = self._y.item()  # lint: hotloop-ok the designated completion check
+        return v
+
+    def stats(self):
+        # NOT reachable from any root: must not flag
+        return self._x.item()

@@ -1,0 +1,60 @@
+"""Fixture for the lockorder pass: parsed by graftlint, never imported."""
+
+import threading
+
+
+class AB:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def one(self):
+        with self._a:
+            with self._b:                  # edge a -> b
+                pass
+
+    def two(self):
+        with self._b:
+            self.helper()                  # closure acquires a: b -> a, CYCLE
+
+    def helper(self):
+        with self._a:
+            pass
+
+
+class SelfNest:
+    def __init__(self):
+        self._m = threading.Lock()
+
+    def outer(self):
+        with self._m:
+            self.inner()                   # FLAG: non-reentrant self-nest
+
+    def inner(self):
+        with self._m:
+            pass
+
+
+class Reentrant:
+    def __init__(self):
+        self._m = threading.RLock()
+
+    def outer(self):
+        with self._m:
+            self.inner()                   # RLock: no flag
+
+    def inner(self):
+        with self._m:
+            pass
+
+
+class ThreadedProbe:
+    def __init__(self):
+        self._m = threading.Lock()
+
+    def start(self):
+        with self._m:
+            def probe():
+                with self._m:              # runs on its own thread: no flag
+                    pass
+            threading.Thread(target=probe).start()

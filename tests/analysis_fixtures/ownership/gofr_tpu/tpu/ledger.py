@@ -1,0 +1,30 @@
+"""Fixture for the ownership pass: parsed by graftlint, never imported."""
+
+from gofr_tpu.tpu.ownership import loop_only
+
+
+class Ledger:
+    def __init__(self):
+        self._acc = 0                      # __init__ writes are exempt
+
+    @loop_only(fields=("_acc",))
+    def bump(self):
+        self._acc += 1                     # marked method: in loop context
+
+    def reset_external(self):
+        self._acc = 0                      # FLAG: owned-field write off-loop
+
+
+class Engine:
+    def __init__(self):
+        self.ledger = Ledger()
+
+    def _loop(self):
+        self.ledger.bump()                 # loop root: fine
+        self._drain()
+
+    def _drain(self):
+        self.ledger.bump()                 # reachable from _loop: fine
+
+    def submit(self):
+        self.ledger.bump()                 # FLAG: @loop_only call off-loop

@@ -1,0 +1,13 @@
+"""Fixture for the surface pass: parsed by graftlint, never imported."""
+
+
+class Plane:
+    def record(self, metrics, app):
+        metrics.increment_counter("app_tpu_documented_total")
+        metrics.increment_counter("app_tpu_missing_total")     # FLAG
+        app.config.get("DOCUMENTED_KEY", "x")
+        app.config.get_int("MISSING_KEY", 1)                   # FLAG
+
+    def install_routes(self, app):
+        app.get("/debug/documented", self.record)
+        app.get("/debug/missing", self.record)                 # FLAG

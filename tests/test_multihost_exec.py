@@ -126,8 +126,11 @@ def test_bad_coordinator_fails_boot_loudly():
         "    'JAX_COORDINATOR_TIMEOUT_S': '5'}))\n"
         "print('SHOULD NOT GET HERE')\n"
     ) % (os.path.dirname(os.path.dirname(os.path.abspath(__file__))), dead_port)
+    # The outer timeout only guards the hang-forever case: the real bound is
+    # the 5s coordinator timeout, but the subprocess first imports jax cold,
+    # which under a fully loaded single-CPU suite run can take minutes.
     proc = subprocess.run([sys.executable, "-c", code], capture_output=True,
-                          text=True, timeout=90, env=env)
+                          text=True, timeout=240, env=env)
     assert proc.returncode != 0
     assert "SHOULD NOT GET HERE" not in proc.stdout
 

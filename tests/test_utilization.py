@@ -11,7 +11,6 @@ documented.
 
 import json
 import os
-import re
 import urllib.request
 
 import pytest
@@ -281,9 +280,8 @@ def _get_req(req):
 
 
 # -- metric-inventory consistency gate ---------------------------------------
-_RECORD_CALL = re.compile(
-    r'(?:\.counter|\.gauge|\.hist|\.hist_n|increment_counter|set_gauge'
-    r'|record_histogram(?:_n)?)\(\s*["\'](app_[a-z0-9_]+)["\']')
+# the extraction itself is shared with graftlint's surface pass — one
+# scanner, consumed by both the runtime gate here and the static gate
 
 
 def test_metric_inventory_consistency():
@@ -291,35 +289,29 @@ def test_metric_inventory_consistency():
     be registered by the runtime's registration paths AND listed in
     docs/observability.md — the gate that catches silent drift like PR 1's
     new gauges landing unregistered/undocumented."""
-    pkg = os.path.join(os.path.dirname(__file__), "..", "gofr_tpu")
-    recorded = set()
-    for sub in ("tpu", "fleet"):
-        scan_dir = os.path.join(pkg, sub)
-        for fname in sorted(os.listdir(scan_dir)):
-            if not fname.endswith(".py"):
-                continue
-            with open(os.path.join(scan_dir, fname), encoding="utf-8") as fp:
-                for name in _RECORD_CALL.findall(fp.read()):
-                    if name.startswith("app_tpu_"):
-                        recorded.add(name)
-    assert recorded, "inventory scan found no recorded metrics (regex rot?)"
-    # the step-anatomy names must be IN the scan (guards regex rot against
+    from tools.analysis.passes.surface import collect_metric_names
+
+    repo = os.path.join(os.path.dirname(__file__), "..")
+    recorded = {name for name in collect_metric_names(repo)
+                if name.startswith("app_tpu_")}
+    assert recorded, "inventory scan found no recorded metrics (scanner rot?)"
+    # the step-anatomy names must be IN the scan (guards scanner rot against
     # the stepledger module's recording style)
     assert "app_tpu_step_seconds" in recorded
     assert "app_tpu_step_stragglers_total" in recorded
-    # the tiered-KV family must be IN the scan (guards regex rot against
+    # the tiered-KV family must be IN the scan (guards scanner rot against
     # paging.py's spill/restore recording style)
     assert any(n.startswith("app_tpu_kv_tier_") for n in recorded), \
         "kv tier counters vanished from the inventory scan"
-    # the disaggregation family must be IN the scan (guards regex rot
+    # the disaggregation family must be IN the scan (guards scanner rot
     # against disagg.py's hand-off recording style)
     assert any(n.startswith("app_tpu_disagg_") for n in recorded), \
         "disagg hand-off counters vanished from the inventory scan"
-    # the fleet-router family must be IN the scan (guards regex rot
+    # the fleet-router family must be IN the scan (guards scanner rot
     # against gofr_tpu/fleet's recording style)
     assert any(n.startswith("app_tpu_fleet_") for n in recorded), \
         "fleet router counters vanished from the inventory scan"
-    # the QoS plane family must be IN the scan (guards regex rot against
+    # the QoS plane family must be IN the scan (guards scanner rot against
     # tpu/qos.py's recording style)
     assert any(n.startswith("app_tpu_qos_") for n in recorded), \
         "qos plane counters vanished from the inventory scan"
@@ -359,8 +351,8 @@ def test_metric_inventory_consistency():
 
 # -- endpoint-inventory consistency gate --------------------------------------
 # route registrations: app.get/post defaults and install_routes path
-# defaults all carry the literal ("/debug/<name>")
-_DEBUG_ROUTE = re.compile(r'["\'](/debug/[a-z_]+)')
+# defaults all carry the literal ("/debug/<name>"); extraction shared
+# with graftlint's surface pass
 
 
 def test_debug_endpoint_inventory_documented():
@@ -368,23 +360,16 @@ def test_debug_endpoint_inventory_documented():
     (app.py + the tpu modules' install_routes) must appear in
     docs/observability.md — the endpoint sibling of the metric gate, so
     a new operator surface cannot ship undocumented."""
-    pkg = os.path.join(os.path.dirname(__file__), "..", "gofr_tpu")
-    sources = [os.path.join(pkg, "app.py")]
-    for sub in ("tpu", "fleet"):
-        sub_dir = os.path.join(pkg, sub)
-        sources += [os.path.join(sub_dir, f)
-                    for f in sorted(os.listdir(sub_dir))
-                    if f.endswith(".py")]
-    routes = set()
-    for path in sources:
-        with open(path, encoding="utf-8") as fp:
-            routes.update(_DEBUG_ROUTE.findall(fp.read()))
-    # regex-rot guard: the known surfaces must all be in the scan
+    from tools.analysis.passes.surface import collect_debug_routes
+
+    repo = os.path.join(os.path.dirname(__file__), "..")
+    routes = set(collect_debug_routes(repo))
+    # scanner-rot guard: the known surfaces must all be in the scan
     for expected in ("/debug/profile", "/debug/requests", "/debug/engine",
                      "/debug/steps", "/debug/faults", "/debug/slo",
                      "/debug/incidents", "/debug/disagg", "/debug/fleet",
                      "/debug/qos"):
-        assert expected in routes, f"scan missed {expected} (regex rot?)"
+        assert expected in routes, f"scan missed {expected} (scanner rot?)"
 
     docs = os.path.join(os.path.dirname(__file__), "..", "docs",
                         "observability.md")

@@ -1,0 +1,1 @@
+"""Repo tooling namespace (soak/bench drivers, graftlint static analysis)."""

@@ -1,0 +1,50 @@
+"""Baseline: grandfathered findings, each with a one-line justification.
+
+The checked-in file (tools/analysis/baseline.json) maps stable finding
+IDs to reasons. A finding whose ID appears there does not fail the run;
+it is reported as `baselined` with its reason. The file ships non-empty
+only because every entry carries a justification — an empty reason is a
+load error, not a suppression.
+
+Stale entries (IDs the tree no longer produces) are surfaced as
+warnings so the ratchet only ever tightens; `--write-baseline`
+regenerates the file from the current failing set, carrying existing
+reasons forward and stamping `TODO: justify` on new entries so a lazy
+regeneration is visible in review.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List
+
+DEFAULT_PATH = os.path.join(os.path.dirname(__file__), "baseline.json")
+
+
+def load(path: str = DEFAULT_PATH) -> Dict[str, str]:
+    if not os.path.exists(path):
+        return {}
+    with open(path, encoding="utf-8") as fp:
+        data = json.load(fp)
+    entries = data.get("findings", {})
+    bad = sorted(fid for fid, reason in entries.items()
+                 if not str(reason).strip())
+    if bad:
+        raise ValueError(
+            "baseline entries without a justification: %s" % bad)
+    return {fid: str(reason) for fid, reason in entries.items()}
+
+
+def save(entries: Dict[str, str], path: str = DEFAULT_PATH) -> None:
+    payload = {
+        "_comment": "graftlint grandfathered findings. Every entry is "
+                    "<stable finding id>: <one-line reason>. Remove an "
+                    "entry when the finding is fixed; the suite warns on "
+                    "stale ids.",
+        "version": 1,
+        "findings": {fid: entries[fid] for fid in sorted(entries)},
+    }
+    with open(path, "w", encoding="utf-8") as fp:
+        json.dump(payload, fp, indent=2, sort_keys=False)
+        fp.write("\n")

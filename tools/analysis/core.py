@@ -1,0 +1,525 @@
+"""Shared analysis substrate: module loader, class registry, call graph.
+
+One `Project` is built per run and handed to every pass. It parses each
+`.py` under the scan roots once (stdlib `ast`, files are never imported —
+fixture trees with deliberately-broken invariants stay inert), indexes
+
+- modules: dotted name, import aliases, `from` imports (relative imports
+  resolved against the package path),
+- classes: bases resolved within the repo, methods, per-attribute type
+  hints inferred from `self.x = ClassName(...)` assignments, lock
+  attributes (`self._lock = threading.Lock()`),
+- functions/methods: one `FuncInfo` per def, with decorator names and
+  the `@loop_only` marker payload,
+
+and builds a best-effort call graph: `self.m()` resolves through the MRO
+*and* repo subclasses (a call in `LLMEngine._loop` reaches the paged
+override), `self.attr.m()` resolves through the inferred attribute type,
+bare and module-qualified names resolve through the import tables. The
+graph over-approximates on inheritance and under-approximates on values
+passed through untyped parameters — every pass that consumes it states
+which side of that bargain it leans on.
+
+Pragmas: a line comment ``# lint: <rule>-ok <reason>`` on the offending
+line or the line directly above suppresses that rule's finding there.
+The reason is REQUIRED — a bare ``# lint: hotloop-ok`` suppresses
+nothing, by design: suppressions are documentation.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+PRAGMA_RE = re.compile(r"#\s*lint:\s*([a-z_]+)-ok\s+(\S.*?)\s*$")
+
+# decorator spelling the ownership pass recognizes (gofr_tpu/tpu/ownership.py)
+LOOP_ONLY_NAMES = ("loop_only",)
+
+
+@dataclass
+class FuncInfo:
+    key: str                   # "gofr_tpu.tpu.engine.LLMEngine._loop"
+    module: str                # dotted module name
+    cls: Optional[str]         # owning class key, or None for module-level
+    name: str
+    qualname: str              # "LLMEngine._loop" or "function"
+    relpath: str               # repo-relative posix path
+    node: ast.AST = field(repr=False)
+    lineno: int = 0
+    decorators: Tuple[str, ...] = ()
+    loop_only: bool = False
+    loop_fields: Tuple[str, ...] = ()
+
+
+@dataclass
+class ClassInfo:
+    key: str                   # "gofr_tpu.tpu.engine.LLMEngine"
+    name: str
+    module: str
+    relpath: str
+    base_names: Tuple[str, ...] = ()       # raw source spellings
+    bases: Tuple[str, ...] = ()            # resolved repo class keys
+    methods: Dict[str, FuncInfo] = field(default_factory=dict)
+    # self.<attr> = ClassName(...)  ->  attr: resolved class key
+    attr_types: Dict[str, str] = field(default_factory=dict)
+    # self.<attr> = threading.Lock()/RLock()/Condition() -> attr: kind
+    lock_attrs: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class ModuleInfo:
+    module: str
+    relpath: str
+    tree: ast.Module = field(repr=False)
+    lines: List[str] = field(repr=False, default_factory=list)
+    # import numpy as np -> {"np": "numpy"}; import jax -> {"jax": "jax"}
+    imports: Dict[str, str] = field(default_factory=dict)
+    # from .obs import MetricsHook as MH -> {"MH": ("gofr_tpu.tpu.obs",
+    #                                              "MetricsHook")}
+    from_imports: Dict[str, Tuple[str, str]] = field(default_factory=dict)
+    classes: Dict[str, ClassInfo] = field(default_factory=dict)
+    functions: Dict[str, FuncInfo] = field(default_factory=dict)
+    pragmas: Dict[int, List[Tuple[str, str]]] = field(default_factory=dict)
+
+
+def _module_name(relpath: str) -> str:
+    parts = relpath[:-3].split("/")
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+def _resolve_relative(module: str, level: int, target: Optional[str],
+                      is_package: bool) -> str:
+    """`from ..http.errors import X` inside gofr_tpu.tpu.qos ->
+    gofr_tpu.http.errors."""
+    parts = module.split(".")
+    if not is_package:
+        parts = parts[:-1]
+    if level > 1:
+        parts = parts[: len(parts) - (level - 1)]
+    if target:
+        parts = parts + target.split(".")
+    return ".".join(p for p in parts if p)
+
+
+def _decorator_names(node) -> Tuple[str, ...]:
+    out = []
+    for dec in getattr(node, "decorator_list", []):
+        expr = dec.func if isinstance(dec, ast.Call) else dec
+        if isinstance(expr, ast.Name):
+            out.append(expr.id)
+        elif isinstance(expr, ast.Attribute):
+            out.append(expr.attr)
+    return tuple(out)
+
+
+def _loop_only_fields(node) -> Tuple[str, ...]:
+    """Extract fields=(...) from a @loop_only(fields=(...)) decoration."""
+    for dec in getattr(node, "decorator_list", []):
+        if not isinstance(dec, ast.Call):
+            continue
+        fn = dec.func
+        name = fn.id if isinstance(fn, ast.Name) else getattr(fn, "attr", "")
+        if name not in LOOP_ONLY_NAMES:
+            continue
+        for kw in dec.keywords:
+            if kw.arg == "fields" and isinstance(kw.value,
+                                                 (ast.Tuple, ast.List)):
+                return tuple(e.value for e in kw.value.elts
+                             if isinstance(e, ast.Constant)
+                             and isinstance(e.value, str))
+    return ()
+
+
+class Project:
+    """Parsed view of one source tree. `root` is the repo root; `scan`
+    lists the top-level directories (repo-relative) to parse."""
+
+    DEFAULT_SCAN = ("gofr_tpu", "examples", "tools")
+
+    def __init__(self, root: str, scan: Sequence[str] = DEFAULT_SCAN):
+        self.root = os.path.abspath(root)
+        self.modules: Dict[str, ModuleInfo] = {}      # by relpath
+        self.by_module: Dict[str, ModuleInfo] = {}    # by dotted name
+        self.classes: Dict[str, ClassInfo] = {}       # by class key
+        self.functions: Dict[str, FuncInfo] = {}      # by func key
+        self.subclasses: Dict[str, Set[str]] = {}
+        self._edges: Optional[Dict[str, Set[str]]] = None
+        for top in scan:
+            top_dir = os.path.join(self.root, top)
+            if os.path.isdir(top_dir):
+                self._load_dir(top_dir)
+            elif os.path.isfile(top_dir) and top_dir.endswith(".py"):
+                self._load_file(top_dir)
+        self._index()
+
+    # -- loading --------------------------------------------------------------
+    def _load_dir(self, top_dir: str) -> None:
+        for dirpath, dirnames, filenames in os.walk(top_dir):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d != "__pycache__"
+                                 and not d.startswith("."))
+            for fname in sorted(filenames):
+                if fname.endswith(".py"):
+                    self._load_file(os.path.join(dirpath, fname))
+
+    def _load_file(self, path: str) -> None:
+        relpath = os.path.relpath(path, self.root).replace(os.sep, "/")
+        try:
+            with open(path, encoding="utf-8") as fp:
+                source = fp.read()
+            tree = ast.parse(source, filename=relpath)
+        except (OSError, SyntaxError):
+            return
+        mod = ModuleInfo(module=_module_name(relpath), relpath=relpath,
+                         tree=tree, lines=source.splitlines())
+        for i, line in enumerate(mod.lines, start=1):
+            for m in PRAGMA_RE.finditer(line):
+                mod.pragmas.setdefault(i, []).append((m.group(1),
+                                                      m.group(2)))
+        self._scan_module(mod)
+        self.modules[relpath] = mod
+        self.by_module[mod.module] = mod
+
+    def _scan_module(self, mod: ModuleInfo) -> None:
+        for node in mod.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                mod.functions[node.name] = self._func(mod, None, node)
+            elif isinstance(node, ast.ClassDef):
+                self._scan_class(mod, node)
+        # imports anywhere in the file, including the lazy function-local
+        # ones this repo uses to defer jax/np; a shadowing local alias is
+        # an acceptable over-approximation (setdefault: top level wins)
+        is_pkg = mod.relpath.endswith("__init__.py")
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    mod.imports.setdefault(
+                        alias.asname or alias.name.split(".")[0],
+                        alias.name)
+            elif isinstance(node, ast.ImportFrom):
+                src = node.module
+                if node.level:
+                    src = _resolve_relative(mod.module, node.level,
+                                            node.module, is_pkg)
+                for alias in node.names:
+                    if alias.name == "*":
+                        continue
+                    mod.from_imports.setdefault(
+                        alias.asname or alias.name, (src or "", alias.name))
+
+    def _func(self, mod: ModuleInfo, cls: Optional[ClassInfo],
+              node) -> FuncInfo:
+        qual = f"{cls.name}.{node.name}" if cls else node.name
+        decos = _decorator_names(node)
+        return FuncInfo(
+            key=f"{mod.module}.{qual}", module=mod.module,
+            cls=cls.key if cls else None, name=node.name, qualname=qual,
+            relpath=mod.relpath, node=node, lineno=node.lineno,
+            decorators=decos,
+            loop_only=any(d in LOOP_ONLY_NAMES for d in decos),
+            loop_fields=_loop_only_fields(node))
+
+    def _scan_class(self, mod: ModuleInfo, node: ast.ClassDef) -> None:
+        base_names = []
+        for b in node.bases:
+            if isinstance(b, ast.Name):
+                base_names.append(b.id)
+            elif isinstance(b, ast.Attribute):
+                base_names.append(ast.unparse(b))
+        cls = ClassInfo(key=f"{mod.module}.{node.name}", name=node.name,
+                        module=mod.module, relpath=mod.relpath,
+                        base_names=tuple(base_names))
+        for item in node.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                cls.methods[item.name] = self._func(mod, cls, item)
+                self._scan_self_assigns(mod, cls, item)
+        mod.classes[node.name] = cls
+
+    _LOCK_CTORS = ("Lock", "RLock", "Condition", "BoundedSemaphore",
+                   "Semaphore")
+
+    def _scan_self_assigns(self, mod: ModuleInfo, cls: ClassInfo,
+                           fn_node) -> None:
+        """Infer `self.x = ClassName(...)` attribute types and
+        `self.x = threading.Lock()` lock attributes anywhere in the
+        class body (not just __init__ — planes are wired late)."""
+        for node in ast.walk(fn_node):
+            if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                continue
+            tgt = node.targets[0]
+            if not (isinstance(tgt, ast.Attribute)
+                    and isinstance(tgt.value, ast.Name)
+                    and tgt.value.id == "self"):
+                continue
+            val = node.value
+            if isinstance(val, ast.IfExp):
+                # `self.x = (Thing(...) if flag else None)` — the gated-
+                # wiring idiom; either arm may carry the constructor
+                val = val.body if isinstance(val.body, ast.Call) \
+                    else val.orelse
+            if not isinstance(val, ast.Call):
+                continue
+            fn = val.func
+            ctor = None
+            if isinstance(fn, ast.Name):
+                ctor = fn.id
+            elif isinstance(fn, ast.Attribute):
+                ctor = fn.attr
+            if ctor in self._LOCK_CTORS:
+                cls.lock_attrs.setdefault(tgt.attr, ctor)
+                continue
+            if ctor:
+                # remember the raw spelling; resolved in _index once all
+                # modules are loaded
+                cls.attr_types.setdefault(tgt.attr, f"?{mod.module}:{ctor}")
+
+    # -- indexing -------------------------------------------------------------
+    def _index(self) -> None:
+        for mod in self.modules.values():
+            for cls in mod.classes.values():
+                self.classes[cls.key] = cls
+                for fn in cls.methods.values():
+                    self.functions[fn.key] = fn
+            for fn in mod.functions.values():
+                self.functions[fn.key] = fn
+        # resolve base names and attr types now that every class is known
+        for mod in self.modules.values():
+            for cls in mod.classes.values():
+                cls.bases = tuple(
+                    k for k in (self.resolve_class(mod, b)
+                                for b in cls.base_names) if k)
+                for attr, raw in list(cls.attr_types.items()):
+                    if not raw.startswith("?"):
+                        continue
+                    src_mod, ctor = raw[1:].split(":", 1)
+                    key = self.resolve_class(self.by_module[src_mod], ctor)
+                    if key:
+                        cls.attr_types[attr] = key
+                    else:
+                        del cls.attr_types[attr]
+        for cls in self.classes.values():
+            for base in cls.bases:
+                self.subclasses.setdefault(base, set()).add(cls.key)
+        # inherit attr/lock tables down the hierarchy (child wins)
+        for cls in self.classes.values():
+            for anc in self.mro(cls.key)[1:]:
+                anc_cls = self.classes.get(anc)
+                if anc_cls is None:
+                    continue
+                for attr, key in anc_cls.attr_types.items():
+                    cls.attr_types.setdefault(attr, key)
+                for attr, kind in anc_cls.lock_attrs.items():
+                    cls.lock_attrs.setdefault(attr, kind)
+
+    def resolve_class(self, mod: ModuleInfo, name: str) -> Optional[str]:
+        """Resolve a class name as spelled in `mod` to a repo class key."""
+        if not name:
+            return None
+        if "." in name:                       # module.Class spelling
+            head, _, tail = name.partition(".")
+            target = mod.imports.get(head)
+            if target is None and head in mod.from_imports:
+                src, sym = mod.from_imports[head]
+                target = f"{src}.{sym}" if src else sym
+            if target:
+                key = f"{target}.{tail}"
+                return key if key in self.classes else None
+            return None
+        if name in mod.classes:
+            return mod.classes[name].key
+        if name in mod.from_imports:
+            src, sym = mod.from_imports[name]
+            key = f"{src}.{sym}" if src else sym
+            if key in self.classes:
+                return key
+            # `from x import y` where y is a module
+            sub = self.by_module.get(key)
+            if sub is not None:
+                return None
+        return None
+
+    def mro(self, cls_key: str) -> List[str]:
+        """Linearized ancestry (DFS, dedup) — C3 precision is not needed
+        for def lookup in this codebase's single-inheritance chains."""
+        out, seen = [], set()
+
+        def walk(key: str) -> None:
+            if key in seen or key not in self.classes:
+                return
+            seen.add(key)
+            out.append(key)
+            for base in self.classes[key].bases:
+                walk(base)
+
+        walk(cls_key)
+        return out
+
+    def all_subclasses(self, cls_key: str) -> Set[str]:
+        out: Set[str] = set()
+        frontier = [cls_key]
+        while frontier:
+            for sub in self.subclasses.get(frontier.pop(), ()):
+                if sub not in out:
+                    out.add(sub)
+                    frontier.append(sub)
+        return out
+
+    def method_targets(self, cls_key: str, method: str) -> List[FuncInfo]:
+        """Defs a `self.<method>()` call in `cls_key` may bind to: the MRO
+        definition plus every subclass override (self may be a subclass
+        instance — LLMEngine._loop dispatching into PagedLLMEngine)."""
+        out: Dict[str, FuncInfo] = {}
+        for key in self.mro(cls_key):
+            cls = self.classes.get(key)
+            if cls and method in cls.methods:
+                out[cls.methods[method].key] = cls.methods[method]
+                break                     # nearest MRO def only
+        for key in sorted(self.all_subclasses(cls_key)):
+            cls = self.classes.get(key)
+            if cls and method in cls.methods:
+                out[cls.methods[method].key] = cls.methods[method]
+        return [out[k] for k in sorted(out)]
+
+    # -- call graph -----------------------------------------------------------
+    def call_edges(self) -> Dict[str, Set[str]]:
+        if self._edges is not None:
+            return self._edges
+        edges: Dict[str, Set[str]] = {}
+        for fn in self.functions.values():
+            edges[fn.key] = set()
+            mod = self.by_module[fn.module]
+            cls = self.classes.get(fn.cls) if fn.cls else None
+            for node in ast.walk(fn.node):
+                if isinstance(node, ast.Call):
+                    for tgt in self.resolve_call(mod, cls, node):
+                        edges[fn.key].add(tgt.key)
+        self._edges = edges
+        return edges
+
+    def resolve_call(self, mod: ModuleInfo, cls: Optional[ClassInfo],
+                     call: ast.Call) -> List[FuncInfo]:
+        fn = call.func
+        # f(...) — module-level or imported
+        if isinstance(fn, ast.Name):
+            name = fn.id
+            if name in mod.functions:
+                return [mod.functions[name]]
+            if name in mod.from_imports:
+                src, sym = mod.from_imports[name]
+                src_mod = self.by_module.get(src)
+                if src_mod and sym in src_mod.functions:
+                    return [src_mod.functions[sym]]
+                key = f"{src}.{sym}" if src else sym
+                if key in self.classes:          # Class(...) -> __init__
+                    return self.method_targets(key, "__init__")
+            if cls and name in mod.classes:
+                pass
+            if name in mod.classes:
+                return self.method_targets(mod.classes[name].key,
+                                           "__init__")
+            return []
+        if not isinstance(fn, ast.Attribute):
+            return []
+        owner = fn.value
+        # self.m(...)
+        if isinstance(owner, ast.Name) and owner.id == "self" and cls:
+            return self.method_targets(cls.key, fn.attr)
+        # super().m(...)
+        if (isinstance(owner, ast.Call) and isinstance(owner.func, ast.Name)
+                and owner.func.id == "super" and cls):
+            for key in self.mro(cls.key)[1:]:
+                anc = self.classes.get(key)
+                if anc and fn.attr in anc.methods:
+                    return [anc.methods[fn.attr]]
+            return []
+        # self.attr.m(...) through the inferred attribute type
+        if (isinstance(owner, ast.Attribute)
+                and isinstance(owner.value, ast.Name)
+                and owner.value.id == "self" and cls):
+            attr_cls = cls.attr_types.get(owner.attr)
+            if attr_cls:
+                return self.method_targets(attr_cls, fn.attr)
+            return []
+        # mod_alias.f(...)
+        if isinstance(owner, ast.Name):
+            target = mod.imports.get(owner.id)
+            if target is None and owner.id in mod.from_imports:
+                src, sym = mod.from_imports[owner.id]
+                target = f"{src}.{sym}" if src else sym
+            if target:
+                t_mod = self.by_module.get(target)
+                if t_mod:
+                    if fn.attr in t_mod.functions:
+                        return [t_mod.functions[fn.attr]]
+                    if fn.attr in t_mod.classes:
+                        return self.method_targets(
+                            t_mod.classes[fn.attr].key, "__init__")
+                key = f"{target}.{fn.attr}"
+                if key in self.classes:
+                    return self.method_targets(key, "__init__")
+        return []
+
+    def reachable(self, roots: Sequence[str]) -> Set[str]:
+        """Transitive closure over the call graph from `roots` (func
+        keys), roots included."""
+        edges = self.call_edges()
+        seen: Set[str] = set()
+        frontier = [r for r in roots if r in edges]
+        seen.update(frontier)
+        while frontier:
+            for tgt in edges.get(frontier.pop(), ()):
+                if tgt not in seen:
+                    seen.add(tgt)
+                    frontier.append(tgt)
+        return seen
+
+    # -- helpers shared by passes --------------------------------------------
+    def alias_root(self, mod: ModuleInfo, node: ast.expr) -> Optional[str]:
+        """Dotted-name root of an expression, resolved through imports:
+        `jnp.asarray` -> "jax.numpy", `np.X` -> "numpy"."""
+        while isinstance(node, ast.Attribute):
+            node = node.value
+        if isinstance(node, ast.Name):
+            if node.id in mod.imports:
+                return mod.imports[node.id]
+            if node.id in mod.from_imports:
+                src, sym = mod.from_imports[node.id]
+                return f"{src}.{sym}" if src else sym
+            return node.id
+        return None
+
+    def pragma_reason(self, relpath: str, rule: str,
+                      line: int) -> Optional[str]:
+        mod = self.modules.get(relpath)
+        if mod is None:
+            return None
+        for ln in (line, line - 1):
+            for prule, reason in mod.pragmas.get(ln, ()):
+                if prule == rule and reason:
+                    return reason
+        return None
+
+
+def walk_scope(root):
+    """ast.walk that does NOT descend into nested function/class bodies:
+    code in a nested def executes later — typically on another thread
+    (daemon probe loops, finisher jobs) — so lock and ownership analysis
+    must not attribute it to the enclosing frame."""
+    from collections import deque
+
+    stop = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+            ast.ClassDef)
+    todo = deque([root])
+    while todo:
+        node = todo.popleft()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, stop):
+                continue
+            todo.append(child)

@@ -1,0 +1,55 @@
+"""Findings: the one record type every pass emits.
+
+Stable IDs are the contract that makes the baseline reviewable: they are
+built from (rule, file, enclosing qualname, symbol, ordinal-within-scope)
+and deliberately EXCLUDE line numbers, so an unrelated edit above a
+grandfathered finding does not churn the baseline diff. The ordinal is
+the finding's rank among same-scope/same-symbol siblings ordered by line,
+so two `.item()` calls in one function stay distinct and stay stable as
+long as their relative order holds.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+
+@dataclass
+class Finding:
+    rule: str            # pass name: hotloop | clock | ownership | ...
+    file: str            # repo-relative posix path
+    qualname: str        # "Class.method", "function", or "<module>"
+    symbol: str          # the offending symbol (e.g. "time.time", ".item")
+    message: str         # one-line human explanation
+    line: int            # 1-based; anchors pragmas, excluded from the id
+    id: str = ""         # assigned by finalize()
+    suppressed: Optional[str] = None   # pragma reason, when suppressed
+    baselined: Optional[str] = None    # baseline reason, when grandfathered
+
+    def to_dict(self) -> Dict[str, object]:
+        out = {"id": self.id, "rule": self.rule, "file": self.file,
+               "qualname": self.qualname, "symbol": self.symbol,
+               "line": self.line, "message": self.message}
+        if self.suppressed is not None:
+            out["suppressed"] = self.suppressed
+        if self.baselined is not None:
+            out["baselined"] = self.baselined
+        return out
+
+
+def finalize(findings: List[Finding]) -> List[Finding]:
+    """Sort deterministically and assign stable IDs.
+
+    Sorting key covers every discriminating field so repeat runs over the
+    same tree byte-compare equal (the de-flake contract pinned by
+    tests/test_analysis.py)."""
+    findings.sort(key=lambda f: (f.rule, f.file, f.qualname, f.line,
+                                 f.symbol, f.message))
+    counters: Dict[tuple, int] = {}
+    for f in findings:
+        scope = (f.rule, f.file, f.qualname, f.symbol)
+        n = counters.get(scope, 0)
+        counters[scope] = n + 1
+        f.id = f"{f.rule}:{f.file}:{f.qualname}:{f.symbol}:{n}"
+    return findings

@@ -1,0 +1,81 @@
+"""clock: monotonic-only telemetry clocks under gofr_tpu/tpu/.
+
+PR 4 unified every engine/recorder/scheduler latency stamp on
+``time.monotonic()`` so TTFT/queue-wait/step math is NTP-step-proof, and
+kept exactly one wall/mono anchor per request for display. This pass
+stops the drift back: every ``time.time()`` call in a file under
+``gofr_tpu/tpu/`` is a finding. Legitimately-wall-clock sites — display
+anchors, file-mtime comparisons, pub/sub lease deadlines — carry a
+``# lint: clock-ok <reason>`` pragma; a latency or deadline computation
+never qualifies (that is the bug class this rule exists for: the qos
+ladder's transition trail shipped on time.time() in PR 11).
+
+Also flagged: ``from time import time`` in scope (the bare ``time()``
+spelling hides from grep and from reviewers equally).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from ..core import Project
+from ..findings import Finding
+
+RULE = "clock"
+BIT = 2
+
+SCOPE = "gofr_tpu/tpu/"
+
+
+def run(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for relpath in sorted(project.modules):
+        if not relpath.startswith(SCOPE):
+            continue
+        mod = project.modules[relpath]
+        # bare-name aliases of time.time in this module's import table
+        bare_aliases = {alias for alias, (src, sym)
+                        in mod.from_imports.items()
+                        if src == "time" and sym == "time"}
+        # containing scope for qualname attribution
+        scopes = [("<module>", mod.tree)]
+        for fn in list(mod.functions.values()):
+            scopes.append((fn.qualname, fn.node))
+        for cls in mod.classes.values():
+            for m in cls.methods.values():
+                scopes.append((m.qualname, m.node))
+
+        for qual, scope_node in scopes:
+            for node in ast.walk(scope_node):
+                if not isinstance(node, ast.Call):
+                    continue
+                fn_expr = node.func
+                is_wall = False
+                symbol = "time.time"
+                if (isinstance(fn_expr, ast.Attribute)
+                        and fn_expr.attr == "time"
+                        and isinstance(fn_expr.value, ast.Name)
+                        and mod.imports.get(fn_expr.value.id) == "time"):
+                    is_wall = True
+                elif (isinstance(fn_expr, ast.Name)
+                        and fn_expr.id in bare_aliases):
+                    is_wall = True
+                    symbol = "time()"
+                if not is_wall:
+                    continue
+                findings.append(Finding(
+                    RULE, relpath, qual, symbol,
+                    "wall-clock read in gofr_tpu/tpu/ — latency and "
+                    "deadline math must use time.monotonic(); pragma "
+                    "display anchors with a reason", node.lineno))
+    # de-dup scope overlap (module walk vs method walk): prefer the
+    # innermost (non-<module>) qualname for each (file, line)
+    best = {}
+    for f in findings:
+        k = (f.file, f.line)
+        cur = best.get(k)
+        if cur is None or (cur.qualname == "<module>"
+                           and f.qualname != "<module>"):
+            best[k] = f
+    return list(best.values())

@@ -1,0 +1,161 @@
+"""hotloop: no host synchronization in the engine's hot loop.
+
+PR 6 tore the host work out of the decode loop (async D2H at dispatch,
+vectorized demux, off-loop finishing); this pass keeps it out. Roots are
+the engine-loop entry points — every function named ``_loop`` or
+matching ``_dispatch_*`` / ``_sync_*`` defined under ``gofr_tpu/tpu/`` —
+and the checked set is everything reachable from them through the call
+graph. Inside that set we flag:
+
+- ``x.item()``                   — a device scalar pull is a full sync
+- ``np.asarray(x)`` / ``np.array(x)`` where ``x`` is device-tainted —
+  blocks until the buffer lands on host (host-side list conversions are
+  fine and common; the taint gate keeps them out)
+- ``jax.device_get(x)``, ``jax.block_until_ready(x)``
+- ``x.block_until_ready()``
+- ``float(x)`` / ``int(x)`` / ``bool(x)`` where ``x`` was assigned from a
+  ``jax``/``jnp`` call or an ``executor.run(...)`` in the same function —
+  the implicit ``__float__`` on a DeviceArray syncs just as hard as
+  ``.item()``
+
+The loop necessarily syncs SOMEWHERE — the designated sync points
+(`_sync_oldest`'s completion check, the hand-off fetch) carry
+``# lint: hotloop-ok <reason>`` pragmas; everything else is a
+regression. Over-approximation note: reachability follows subclass
+overrides, so a finding in a paged override reached only from the dense
+loop is still reported — that is the point.
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+from typing import List
+
+from ..core import ModuleInfo, Project
+from ..findings import Finding
+
+RULE = "hotloop"
+BIT = 1
+
+ROOT_PATTERNS = ("_loop", "_dispatch_*", "_sync_*")
+ROOT_DIR = "gofr_tpu/tpu/"
+
+# dotted roots (post-alias-resolution) that produce device values
+_DEVICE_ROOTS = ("jax", "jax.numpy")
+_NUMPY_ROOTS = ("numpy",)
+_SYNC_JAX_FNS = ("device_get", "block_until_ready")
+_NUMPY_SYNC_FNS = ("asarray", "array")
+_COERCIONS = ("float", "int", "bool")
+
+
+def is_root(fn_name: str, relpath: str) -> bool:
+    return relpath.startswith(ROOT_DIR) and any(
+        fnmatch.fnmatchcase(fn_name, pat) for pat in ROOT_PATTERNS)
+
+
+def _device_tainted_names(project: Project, mod: ModuleInfo,
+                          fn_node) -> set:
+    """Names assigned (directly) from a device-producing call within this
+    function: `x = jnp.argmax(...)`, `out = self.executor.run(...)`."""
+    tainted = set()
+    for node in ast.walk(fn_node):
+        if not isinstance(node, ast.Assign):
+            continue
+        val = node.value
+        if not isinstance(val, ast.Call):
+            continue
+        produced = False
+        fn = val.func
+        root = project.alias_root(mod, fn)
+        if root in _DEVICE_ROOTS or (root or "").startswith("jax."):
+            produced = True
+        elif isinstance(fn, ast.Attribute) and fn.attr == "run":
+            owner = fn.value
+            owner_name = owner.attr if isinstance(owner, ast.Attribute) \
+                else getattr(owner, "id", "")
+            if "executor" in owner_name:
+                produced = True
+        if produced:
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    tainted.add(tgt.id)
+                elif isinstance(tgt, ast.Tuple):
+                    tainted.update(e.id for e in tgt.elts
+                                   if isinstance(e, ast.Name))
+    return tainted
+
+
+def _device_arg(project: Project, mod: ModuleInfo, arg: ast.expr,
+                tainted: set) -> bool:
+    """Is this np.asarray/np.array argument a device value? Tainted name,
+    slice of a tainted name, or a direct jax/jnp-producing call. Host
+    list/tuple conversions — the overwhelmingly common case — stay out."""
+    if isinstance(arg, ast.Name):
+        return arg.id in tainted
+    if isinstance(arg, ast.Subscript):
+        return isinstance(arg.value, ast.Name) and arg.value.id in tainted
+    if isinstance(arg, ast.Call):
+        root = project.alias_root(mod, arg.func)
+        return root in _DEVICE_ROOTS or (root or "").startswith("jax.")
+    return False
+
+
+def run(project: Project) -> List[Finding]:
+    roots = [fn.key for fn in project.functions.values()
+             if is_root(fn.name, fn.relpath)]
+    hot = project.reachable(sorted(roots))
+    findings: List[Finding] = []
+    for key in sorted(hot):
+        fn = project.functions[key]
+        mod = project.modules[fn.relpath]
+        tainted = _device_tainted_names(project, mod, fn.node)
+        for node in ast.walk(fn.node):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = node.func
+            if isinstance(callee, ast.Attribute):
+                if callee.attr == "item" and not node.args:
+                    findings.append(Finding(
+                        RULE, fn.relpath, fn.qualname, ".item",
+                        "device scalar pull (.item()) in a hot-loop-"
+                        "reachable function forces a host sync",
+                        node.lineno))
+                    continue
+                if callee.attr == "block_until_ready":
+                    findings.append(Finding(
+                        RULE, fn.relpath, fn.qualname,
+                        ".block_until_ready",
+                        "explicit device sync in a hot-loop-reachable "
+                        "function", node.lineno))
+                    continue
+                root = project.alias_root(mod, callee)
+                if (root in _NUMPY_ROOTS and callee.attr in _NUMPY_SYNC_FNS
+                        and node.args
+                        and _device_arg(project, mod, node.args[0],
+                                        tainted)):
+                    findings.append(Finding(
+                        RULE, fn.relpath, fn.qualname,
+                        f"np.{callee.attr}",
+                        "np.%s() on a device value blocks until the "
+                        "buffer lands on host" % callee.attr,
+                        node.lineno))
+                    continue
+                if root in _DEVICE_ROOTS and callee.attr in _SYNC_JAX_FNS:
+                    findings.append(Finding(
+                        RULE, fn.relpath, fn.qualname,
+                        f"jax.{callee.attr}",
+                        "jax.%s() in a hot-loop-reachable function is a "
+                        "host sync" % callee.attr, node.lineno))
+                    continue
+            elif isinstance(callee, ast.Name):
+                if (callee.id in _COERCIONS and len(node.args) == 1
+                        and isinstance(node.args[0], ast.Name)
+                        and node.args[0].id in tainted):
+                    findings.append(Finding(
+                        RULE, fn.relpath, fn.qualname,
+                        f"{callee.id}()",
+                        "%s() coercion of a device value (implicit "
+                        "__%s__ sync) in a hot-loop-reachable function"
+                        % (callee.id, callee.id), node.lineno))
+    return findings

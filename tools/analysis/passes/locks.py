@@ -1,0 +1,160 @@
+"""lockorder: the `with self._lock` nesting graph — cycles and nesting.
+
+Lock identity is (class, attribute): every ``self.<attr>`` that is
+assigned ``threading.Lock()`` / ``RLock()`` / ``Condition()`` anywhere
+in a class (or a base) names one lock. For every ``with self.<lock>:``
+site the pass computes which OTHER locks the body may acquire — direct
+nested ``with`` blocks plus the transitive acquisition closure of every
+call in the body, through the call graph — and emits:
+
+- ``cycle``: two locks each reachable-while-holding the other (the
+  classic AB/BA deadlock), or a non-reentrant lock re-acquired under
+  itself. These are the hard failures.
+- ``nested``: a distinct (outer, inner) acquisition edge. Nesting is not
+  a bug by itself, but every edge is a held-lock dependency someone must
+  have THOUGHT about — acknowledged edges live in the baseline with a
+  one-line justification (or a ``# lint: lockorder-ok`` pragma at the
+  with-site), so a NEW edge in review is a diff line, not a silent
+  widening of the deadlock surface.
+
+The closure over-approximates (name-based call resolution), so an edge
+may be infeasible in practice — that is what the justification line in
+the baseline is for. Scope: gofr_tpu/tpu/, gofr_tpu/fleet/,
+gofr_tpu/metrics/ (the lock population the serving plane actually
+shares); cycles are reported wherever found.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core import ClassInfo, FuncInfo, Project, walk_scope
+from ..findings import Finding
+
+RULE = "lockorder"
+BIT = 8
+
+SCOPES = ("gofr_tpu/tpu/", "gofr_tpu/fleet/", "gofr_tpu/metrics/")
+
+
+def _lock_id(cls: ClassInfo, attr: str) -> str:
+    return f"{cls.name}.{attr}"
+
+
+def _in_scope(relpath: str) -> bool:
+    return any(relpath.startswith(s) for s in SCOPES)
+
+
+def _lock_attr(cls: Optional[ClassInfo], node: ast.expr) -> Optional[str]:
+    """`with self.<attr>:` where <attr> is a known lock of cls."""
+    if (cls is not None and isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"
+            and node.attr in cls.lock_attrs):
+        return node.attr
+    return None
+
+
+def _direct_acquires(project: Project, fn: FuncInfo) -> List[Tuple[str, ast.With]]:
+    cls = project.classes.get(fn.cls) if fn.cls else None
+    out = []
+    # walk_scope: a nested def (probe thread, finisher job) runs on its
+    # own frame/thread — its acquisitions are not held by this function.
+    for node in walk_scope(fn.node):
+        if not isinstance(node, ast.With):
+            continue
+        for item in node.items:
+            attr = _lock_attr(cls, item.context_expr)
+            if attr is not None:
+                out.append((_lock_id(cls, attr), node))
+    return out
+
+
+def _acquire_closure(project: Project) -> Dict[str, Set[str]]:
+    """func key -> set of lock ids the function may acquire, transitively
+    through its callees (fixpoint over the call graph)."""
+    edges = project.call_edges()
+    acq: Dict[str, Set[str]] = {}
+    for key in edges:
+        fn = project.functions[key]
+        acq[key] = {lock for lock, _ in _direct_acquires(project, fn)}
+    changed = True
+    while changed:
+        changed = False
+        for key in edges:
+            before = len(acq[key])
+            for callee in edges[key]:
+                acq[key] |= acq.get(callee, set())
+            if len(acq[key]) != before:
+                changed = True
+    return acq
+
+
+def run(project: Project) -> List[Finding]:
+    acq_closure = _acquire_closure(project)
+    edges = project.call_edges()
+
+    # nesting edges: (outer, inner) -> first (file, qualname, line)
+    nest: Dict[Tuple[str, str], Tuple[str, str, int]] = {}
+    self_nest: Dict[str, Tuple[str, str, int]] = {}
+
+    for key in sorted(project.functions):
+        fn = project.functions[key]
+        cls = project.classes.get(fn.cls) if fn.cls else None
+        if cls is None:
+            continue
+        mod = project.modules[fn.relpath]
+        for lock, with_node in _direct_acquires(project, fn):
+            inner_locks: Set[str] = set()
+            for node in walk_scope(with_node):
+                if isinstance(node, ast.With) and node is not with_node:
+                    for item in node.items:
+                        attr = _lock_attr(cls, item.context_expr)
+                        if attr is not None:
+                            inner_locks.add(_lock_id(cls, attr))
+                if isinstance(node, ast.Call):
+                    for tgt in project.resolve_call(mod, cls, node):
+                        inner_locks |= acq_closure.get(tgt.key, set())
+            site = (fn.relpath, fn.qualname, with_node.lineno)
+            for inner in sorted(inner_locks):
+                if inner == lock:
+                    kind = cls.lock_attrs.get(lock.split(".", 1)[1], "")
+                    if kind == "RLock":
+                        continue        # reentrant by construction
+                    self_nest.setdefault(lock, site)
+                else:
+                    nest.setdefault((lock, inner), site)
+
+    findings: List[Finding] = []
+
+    # cycles: self-nesting of a non-reentrant lock ...
+    for lock in sorted(self_nest):
+        relpath, qual, line = self_nest[lock]
+        findings.append(Finding(
+            RULE, relpath, qual, f"cycle:{lock}->{lock}",
+            "non-reentrant lock %s may be re-acquired while held "
+            "(self-deadlock)" % lock, line))
+    # ... and 2+-node cycles in the nesting graph
+    seen_pairs = set(nest)
+    for (a, b) in sorted(seen_pairs):
+        if (b, a) in seen_pairs and a < b:
+            relpath, qual, line = nest[(a, b)]
+            findings.append(Finding(
+                RULE, relpath, qual, f"cycle:{a}<->{b}",
+                "lock-order cycle: %s and %s are each acquired while "
+                "the other is held (AB/BA deadlock)" % (a, b), line))
+
+    # nesting edges (documentation ratchet), only within scope
+    for (a, b) in sorted(seen_pairs):
+        relpath, qual, line = nest[(a, b)]
+        if not _in_scope(relpath):
+            continue
+        if (b, a) in seen_pairs:
+            continue                    # already reported as a cycle
+        findings.append(Finding(
+            RULE, relpath, qual, f"nested:{a}->{b}",
+            "nested lock acquisition: %s is (possibly transitively) "
+            "acquired while %s is held — acknowledge in the baseline "
+            "or restructure" % (b, a), line))
+    return findings

@@ -1,0 +1,116 @@
+"""ownership: @loop_only methods are only reached from loop-rooted paths.
+
+The runtime marker (gofr_tpu/tpu/ownership.py) formalizes the
+"loop-thread-only" comments; this pass enforces it. A function is
+**loop context** when it (a) is named ``_loop``, (b) is itself decorated
+``@loop_only``, or (c) is reachable from a ``_loop`` root through the
+call graph. Findings:
+
+- a call to a ``@loop_only`` method from a function that is NOT loop
+  context (a submit-thread helper reaching into loop-owned state);
+- a write (`self.f = ...` / augmented assign) to a field declared in a
+  ``@loop_only(fields=(...))`` decoration of the same class hierarchy,
+  from a method that is not loop context. ``__init__`` is exempt — the
+  constructing thread owns the object before the loop exists.
+
+Known under-approximation: a function reachable from BOTH the loop and a
+foreign thread passes (it is loop-reachable); the race detector this
+pass is not would catch that. Known over-approximation: every ``_loop``
+in the tree counts as loop context (the batcher and lane loops are
+different threads than the engine loop) — cross-loop aliasing is out of
+scope for v1 and documented in docs/static-analysis.md.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set, Tuple
+
+from ..core import Project
+from ..findings import Finding
+
+RULE = "ownership"
+BIT = 4
+
+
+def _owned_fields(project: Project) -> Dict[str, Dict[str, str]]:
+    """class key -> {field: declaring method qualname}, merged down the
+    hierarchy (a field declared on the base is owned in subclasses)."""
+    declared: Dict[str, Dict[str, str]] = {}
+    for cls_key in sorted(project.classes):
+        cls = project.classes[cls_key]
+        table: Dict[str, str] = {}
+        for anc in reversed(project.mro(cls_key)):
+            anc_cls = project.classes.get(anc)
+            if anc_cls is None:
+                continue
+            for m in anc_cls.methods.values():
+                for f in m.loop_fields:
+                    table[f] = m.qualname
+        if table:
+            declared[cls_key] = table
+    return declared
+
+
+def run(project: Project) -> List[Finding]:
+    marked: Set[str] = {k for k, fn in project.functions.items()
+                        if fn.loop_only}
+    loop_roots = sorted(k for k, fn in project.functions.items()
+                        if fn.name == "_loop")
+    loop_ctx: Set[str] = project.reachable(loop_roots) | marked
+
+    findings: List[Finding] = []
+    edges = project.call_edges()
+
+    # (1) calls into marked methods from non-loop context
+    for caller_key in sorted(edges):
+        if caller_key in loop_ctx:
+            continue
+        caller = project.functions[caller_key]
+        hit = sorted(t for t in edges[caller_key] if t in marked)
+        if not hit:
+            continue
+        mod = project.modules[caller.relpath]
+        cls = project.classes.get(caller.cls) if caller.cls else None
+        # re-resolve per call site for line-accurate findings
+        for node in ast.walk(caller.node):
+            if not isinstance(node, ast.Call):
+                continue
+            for tgt in project.resolve_call(mod, cls, node):
+                if tgt.key in marked:
+                    findings.append(Finding(
+                        RULE, caller.relpath, caller.qualname,
+                        tgt.qualname,
+                        "call into @loop_only %s from a function that "
+                        "is not loop-rooted (not reachable from any "
+                        "_loop, not itself @loop_only)" % tgt.qualname,
+                        node.lineno))
+
+    # (2) writes to owned fields from non-loop-context methods
+    owned = _owned_fields(project)
+    for cls_key in sorted(owned):
+        cls = project.classes[cls_key]
+        fields = owned[cls_key]
+        for mname in sorted(cls.methods):
+            method = cls.methods[mname]
+            if method.key in loop_ctx or mname == "__init__":
+                continue
+            for node in ast.walk(method.node):
+                targets: List[ast.expr] = []
+                if isinstance(node, ast.Assign):
+                    targets = node.targets
+                elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                    targets = [node.target]
+                for tgt in targets:
+                    if (isinstance(tgt, ast.Attribute)
+                            and isinstance(tgt.value, ast.Name)
+                            and tgt.value.id == "self"
+                            and tgt.attr in fields):
+                        findings.append(Finding(
+                            RULE, method.relpath, method.qualname,
+                            f"self.{tgt.attr}",
+                            "write to loop-owned field %r (declared by "
+                            "@loop_only on %s) from non-loop-context "
+                            "method" % (tgt.attr, fields[tgt.attr]),
+                            node.lineno))
+    return findings

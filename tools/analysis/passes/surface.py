@@ -1,0 +1,163 @@
+"""surface: metric/config/endpoint inventory vs the documentation.
+
+The runtime inventory tests (tests/test_utilization.py) already gate
+"every recorded app_tpu_* metric is registered"; the *documented* half
+of that contract — and its config-key and /debug-endpoint siblings —
+is pure static extraction, so it lives here and the tests import THESE
+extractors instead of keeping private regexes that rot independently:
+
+- :func:`collect_metric_names` — string literals recorded through the
+  repo's recording calls (``increment_counter`` / ``set_gauge`` /
+  ``record_histogram[_n]`` and the MetricsHook ``counter`` / ``gauge`` /
+  ``hist[_n]`` verbs) in gofr_tpu/tpu/ + gofr_tpu/fleet/.
+- :func:`collect_debug_routes` — ``/debug/*`` route literals in app.py
+  and the tpu/fleet modules' install_routes defaults.
+- :func:`collect_config_keys` — UPPER_CASE keys read via
+  ``config.get*()`` across gofr_tpu/ and examples/.
+
+Findings: a recorded ``app_tpu_*`` metric or a ``/debug/*`` route
+missing from docs/observability.md; a config key missing from
+docs/configs.md. The pragma goes on the recording/reading site.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Optional, Tuple
+
+from ..core import Project
+from ..findings import Finding
+
+RULE = "surface"
+BIT = 16
+
+_RECORD_ATTRS = ("increment_counter", "set_gauge", "record_histogram",
+                 "record_histogram_n", "counter", "gauge", "hist",
+                 "hist_n")
+_CONFIG_ATTRS = ("get", "get_or_default", "get_int", "get_float",
+                 "get_bool")
+_CONFIG_KEY_RE = re.compile(r"^[A-Z][A-Z0-9_]*$")
+_DEBUG_ROUTE_RE = re.compile(r"^/debug/[a-z_]+$")
+
+METRIC_SCOPES = ("gofr_tpu/tpu/", "gofr_tpu/fleet/")
+ROUTE_SCOPES = ("gofr_tpu/app.py", "gofr_tpu/tpu/", "gofr_tpu/fleet/")
+CONFIG_SCOPES = ("gofr_tpu/", "examples/")
+
+
+def _project(root_or_project) -> Project:
+    if isinstance(root_or_project, Project):
+        return root_or_project
+    return Project(root_or_project)
+
+
+def collect_metric_names(root_or_project,
+                         prefix: str = "app_") -> Dict[str, Tuple[str, int]]:
+    """{metric name: (file, first line)} for every literal-name recording
+    call in the metric scopes."""
+    project = _project(root_or_project)
+    out: Dict[str, Tuple[str, int]] = {}
+    for relpath in sorted(project.modules):
+        if not any(relpath.startswith(s) for s in METRIC_SCOPES):
+            continue
+        for node in ast.walk(project.modules[relpath].tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _RECORD_ATTRS
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                continue
+            name = node.args[0].value
+            if name.startswith(prefix) and name not in out:
+                out[name] = (relpath, node.lineno)
+    return out
+
+
+def collect_debug_routes(root_or_project) -> Dict[str, Tuple[str, int]]:
+    """{route: (file, first line)} for every /debug/* string literal in
+    the route scopes (route registrations carry the literal)."""
+    project = _project(root_or_project)
+    out: Dict[str, Tuple[str, int]] = {}
+    for relpath in sorted(project.modules):
+        if not any(relpath.startswith(s) for s in ROUTE_SCOPES):
+            continue
+        for node in ast.walk(project.modules[relpath].tree):
+            if (isinstance(node, ast.Constant)
+                    and isinstance(node.value, str)):
+                val = node.value.split("/{", 1)[0]  # "/debug/x/{id}" -> base
+                if _DEBUG_ROUTE_RE.match(val) and val not in out:
+                    out[val] = (relpath, node.lineno)
+    return out
+
+
+def collect_config_keys(root_or_project) -> Dict[str, Tuple[str, int]]:
+    """{KEY: (file, first line)} for every UPPER_CASE key read through a
+    config getter on a receiver whose attribute chain ends in `config`."""
+    project = _project(root_or_project)
+    out: Dict[str, Tuple[str, int]] = {}
+    for relpath in sorted(project.modules):
+        if not any(relpath.startswith(s) for s in CONFIG_SCOPES):
+            continue
+        for node in ast.walk(project.modules[relpath].tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _CONFIG_ATTRS
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                continue
+            recv = node.func.value
+            recv_name = recv.attr if isinstance(recv, ast.Attribute) \
+                else getattr(recv, "id", "")
+            if recv_name not in ("config", "cfg_env"):
+                continue
+            key = node.args[0].value
+            if _CONFIG_KEY_RE.match(key) and key not in out:
+                out[key] = (relpath, node.lineno)
+    return out
+
+
+def _read_doc(root: str, name: str) -> str:
+    try:
+        with open(os.path.join(root, "docs", name), encoding="utf-8") as fp:
+            return fp.read()
+    except OSError:
+        return ""
+
+
+def run(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    obs_doc = _read_doc(project.root, "observability.md")
+    cfg_doc = _read_doc(project.root, "configs.md")
+
+    metrics = collect_metric_names(project)
+    for name in sorted(metrics):
+        if not name.startswith("app_tpu_"):
+            continue
+        relpath, line = metrics[name]
+        if name not in obs_doc:
+            findings.append(Finding(
+                RULE, relpath, "<module>", name,
+                "metric %s is recorded but not documented in "
+                "docs/observability.md" % name, line))
+
+    routes = collect_debug_routes(project)
+    for route in sorted(routes):
+        relpath, line = routes[route]
+        if route not in obs_doc:
+            findings.append(Finding(
+                RULE, relpath, "<module>", route,
+                "operator endpoint %s is registered but not documented "
+                "in docs/observability.md" % route, line))
+
+    keys = collect_config_keys(project)
+    for key in sorted(keys):
+        relpath, line = keys[key]
+        if key not in cfg_doc:
+            findings.append(Finding(
+                RULE, relpath, "<module>", key,
+                "config key %s is read but not documented in "
+                "docs/configs.md" % key, line))
+    return findings
