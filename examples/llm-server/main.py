@@ -423,6 +423,10 @@ def build_app(config=None, engine=None) -> App:
     # the app's metrics/tracer sinks and the routes then
     if app.config.get_bool("FLIGHT_RECORDER", True):
         app.enable_flight_recorder(engine)
+        # journey surface: GET /debug/journey[/{id}] assembles this
+        # replica's recorder(s) — both halves of a DISAGG both pair —
+        # into the same hop waterfall the fleet router serves
+        app.enable_journey(engine)
     # fleet-level sibling: GET /debug/engine (slots / page pool / compile
     # table / MFU-MBU utilization window) + HBM sampler; ENGINE_SNAPSHOT=
     # false opts out

@@ -77,6 +77,8 @@ def build_app(**kw) -> App:
     # (llm-server parity; FLIGHT_RECORDER=false opts out)
     if app.config.get_bool("FLIGHT_RECORDER", True):
         app.enable_flight_recorder(engine)
+        # uniform journey surface: GET /debug/journey[/{id}] here too
+        app.enable_journey(engine)
     # GET /debug/engine + utilization gauges + HBM sampler (llm-server
     # parity; ENGINE_SNAPSHOT=false opts out)
     if app.config.get_bool("ENGINE_SNAPSHOT", True):

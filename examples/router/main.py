@@ -27,7 +27,12 @@ holds the handler until the replica finishes generating.
 import os
 
 from gofr_tpu import App
-from gofr_tpu.fleet import FleetRouter, install_routes, register_fleet_metrics
+from gofr_tpu.fleet import (FleetRouter, FleetSLO, JourneyRecorder,
+                            install_routes, register_fleet_metrics,
+                            register_fleet_slo_metrics,
+                            register_journey_metrics)
+from gofr_tpu.fleet.journey import install_routes as install_journey_routes
+from gofr_tpu.fleet.slo import install_routes as install_fleet_slo_routes
 
 
 def build_app(config=None) -> App:
@@ -35,15 +40,57 @@ def build_app(config=None) -> App:
     path is the real handler + pass-through stream).  The router rides on
     `app.fleet`."""
     app = App(config=config)
-    register_fleet_metrics(app.container.metrics_manager)
+    metrics = app.container.metrics_manager
+    register_fleet_metrics(metrics)
     router = FleetRouter.from_config(app.config, logger=app.logger,
-                                     metrics=app.container.metrics_manager)
+                                     metrics=metrics)
     app.fleet = router
     # the router's own /.well-known/health reports DOWN when no replica
     # is routable, DEGRADED while any is ejected — upstream LBs can use
     # the same signal clients of a single replica already understand
     app.container.add_health_contributor("fleet", router.health_check)
     install_routes(app, router)
+    # fleet observability plane: per-request journey recorder + cross-hop
+    # assembly at GET /debug/journey[/{id}] (FLEET_JOURNEY=false opts out)
+    if app.config.get_bool("FLEET_JOURNEY", True):
+        if metrics is not None:
+            register_journey_metrics(metrics)
+        router.journeys = JourneyRecorder(
+            capacity=app.config.get_int("FLEET_JOURNEY_CAPACITY", 256),
+            metrics=metrics)
+        install_journey_routes(app, router)
+    # fleet SLO rollup: router-observed burn windows + per-replica
+    # /debug/slo merge at GET /debug/fleet/slo, with a router-owned
+    # IncidentManager that captures fleet_burn_hidden bundles when fleet
+    # burn pages while every replica is quiet (FLEET_SLO=false opts out)
+    if app.config.get_bool("FLEET_SLO", True):
+        from gofr_tpu.tpu.incidents import (IncidentManager,
+                                            install_routes as
+                                            install_incident_routes,
+                                            register_incident_metrics)
+
+        if metrics is not None:
+            register_fleet_slo_metrics(metrics)
+            register_incident_metrics(metrics)
+        incidents = IncidentManager(
+            engine=None, recorder=None,
+            dir=app.config.get_or_default("INCIDENT_DIR", "./incidents"),
+            cooldown_s=app.config.get_float("INCIDENT_COOLDOWN_S", 300.0),
+            max_per_hour=app.config.get_int("INCIDENT_MAX_PER_HOUR", 6),
+            metrics=metrics, logger=app.logger)
+        router.slo = FleetSLO.from_config(
+            app.config, registry=router.registry, incidents=incidents,
+            metrics=metrics, logger=app.logger)
+        app.fleet_incidents = incidents
+        if router.journeys is not None:
+            router.journeys.use_slo(router.slo)
+        install_fleet_slo_routes(app, router)
+        # uniform operator surface: the router answers /debug/slo (its
+        # own burn engine) and /debug/incidents like any replica does
+        install_incident_routes(app, router.slo.burn, incidents)
+        # burn must DECAY while the router idles: re-evaluate at scrape
+        app.container.add_scrape_hook("fleet_slo_burn",
+                                      router.slo.burn.publish)
     router.start()
     app.on_shutdown(router.stop)
     return app

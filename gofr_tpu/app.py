@@ -276,10 +276,39 @@ class App:
         else:
             recorder.use_metrics(self.container.metrics_manager)
             recorder.use_tracer(self.container.tracer)
+        # DISAGG_MODE=both: the prefill pool gets its own recorder so the
+        # prefill half of every hand-off is visible to journey assembly
+        # (tpu/journey.py) and emits engine spans on the shared trace.
+        # metrics stays None — the client-facing goodput gauges belong to
+        # the serving (decode) engine's recorder alone
+        disagg = getattr(engine, "disagg_router", None)
+        prefill = (getattr(disagg, "prefill_engine", None)
+                   if disagg is not None else None)
+        if prefill is not None and getattr(prefill, "recorder", None) is None:
+            prefill.recorder = FlightRecorder(
+                capacity=self.config.get_int("FLIGHT_RECORDER_CAPACITY", 256),
+                max_events=self.config.get_int(
+                    "FLIGHT_RECORDER_MAX_EVENTS", 512),
+                tracer=self.container.tracer)
         if self.container.metrics_manager is not None:
             register_slo_gauges(self.container.metrics_manager)
         install_routes(self, recorder, path)
         return recorder
+
+    def enable_journey(self, engine, path: str = "/debug/journey"):
+        """Expose the replica-local journey surface (tpu/journey.py):
+        GET /debug/journey (recent index) and GET /debug/journey/{id}
+        (one causally-ordered hop waterfall, id = engine request id or
+        32-hex trace id) — the same endpoint shape the fleet router
+        serves, assembled here from this replica's flight recorder(s)
+        (both halves of a DISAGG both pair). Requires a flight recorder
+        (enable_flight_recorder); returns None without one."""
+        if getattr(engine, "recorder", None) is None:
+            return None
+        from .tpu.journey import install_routes as install_journey_routes
+
+        install_journey_routes(self, engine, path)
+        return engine.recorder
 
     def enable_fault_injection(self, engine, path: str = "/debug/faults"):
         """Arm the chaos plane (tpu/faults.py) on an engine and expose the

@@ -30,14 +30,18 @@ the failure matrix.
 
 from .affinity import AffinityMap, AffinityRecorder, affinity_keys
 from .debug import register_fleet_metrics
+from .journey import JourneyRecorder, register_journey_metrics
 from .policy import (AffinityPolicy, P2CPolicy, RoundRobinPolicy,
                      RoutingPolicy, make_policy)
 from .proxy import FleetRouter, install_routes
 from .registry import FleetRegistry, Replica
+from .slo import FleetBurnEngine, FleetSLO, register_fleet_slo_metrics
 
 __all__ = [
     "AffinityMap", "AffinityRecorder", "affinity_keys",
     "AffinityPolicy", "P2CPolicy", "RoundRobinPolicy", "RoutingPolicy",
     "make_policy", "FleetRouter", "install_routes", "FleetRegistry",
     "Replica", "register_fleet_metrics",
+    "JourneyRecorder", "register_journey_metrics",
+    "FleetBurnEngine", "FleetSLO", "register_fleet_slo_metrics",
 ]

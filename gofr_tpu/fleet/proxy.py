@@ -59,6 +59,11 @@ class FleetRouter:
         # by the retry loop — the fleet-level view of replica shedding
         self.class_routes = {}
         self.class_sheds = {}
+        # observability plane, attached by the router app (None-guarded
+        # on every touch so the forwarding path never depends on it):
+        # journeys = fleet/journey.py recorder, slo = fleet/slo.py rollup
+        self.journeys = None
+        self.slo = None
 
     @classmethod
     def from_config(cls, config, logger=None, metrics=None):
@@ -151,6 +156,14 @@ class FleetRouter:
         prompt = body.get("prompt", "")
         keys = affinity_keys(prompt, self.affinity_block,
                              self.affinity_max_blocks)
+        journeys = self.journeys
+        journey = None
+        if journeys is not None:
+            span = getattr(ctx, "span", None)
+            journey = journeys.begin(
+                trace_id=getattr(span, "trace_id", None),
+                qos_class=qos_class, tenant=body.get("tenant"),
+                prompt_chars=len(prompt))
         tried = set()
         attempts = 1 + self.retry_budget
         shortest_shed = None
@@ -161,6 +174,8 @@ class FleetRouter:
             replica, reason = self.policy.choose(candidates, keys,
                                                  self.affinity_map)
             self._count_route(reason)
+            if journeys is not None:
+                journeys.attempt(journey, replica.name, reason)
             replica.begin()
             try:
                 resp = replica.client.request(ctx, "POST", "/generate",
@@ -171,6 +186,8 @@ class FleetRouter:
                 kind = ("breaker_open" if isinstance(exc, CircuitOpenError)
                         else "connect_error")
                 self._count_retry(kind)
+                if journeys is not None:
+                    journeys.attempt_outcome(journey, kind)
                 if self.logger is not None:
                     self.logger.warnf("fleet: %s to %s (attempt %d): %s",
                                       kind, replica.name, attempt + 1, exc)
@@ -184,6 +201,8 @@ class FleetRouter:
                 replica.end()
                 tried.add(replica.name)
                 self._count_retry("shed")
+                if journeys is not None:
+                    journeys.attempt_outcome(journey, "shed")
                 self._count_class(self.class_sheds,
                                   "app_tpu_fleet_class_sheds_total",
                                   qos_class)
@@ -191,9 +210,14 @@ class FleetRouter:
             # committed to this replica from here on — no more retries
             self._count_class(self.class_routes,
                               "app_tpu_fleet_class_routes_total", qos_class)
+            if journeys is not None:
+                journeys.committed(journey, replica.name, resp.status_code)
             if resp.status_code >= 400:
                 content = resp.read()
                 replica.end()
+                if journeys is not None:
+                    journeys.finish(journey, "upstream_error",
+                                    error=f"upstream {resp.status_code}")
                 return Response(
                     status=resp.status_code,
                     headers={"Content-Type": resp.header("Content-Type")
@@ -205,14 +229,19 @@ class FleetRouter:
                     or resp.header("Transfer-Encoding") == "chunked"):
                 return self._passthrough_stream(resp, replica,
                                                 content_type
-                                                or "text/event-stream")
+                                                or "text/event-stream",
+                                                journey)
             content = resp.read()
             replica.end()
+            if journeys is not None:
+                journeys.finish(journey, "ok")
             return Response(
                 status=resp.status_code,
                 headers={"Content-Type": content_type or "application/json"},
                 body=content)
         self.no_replica += 1
+        if journeys is not None:
+            journeys.finish(journey, "no_replica")
         retry_after = shortest_shed or self.registry.probe_s or 1.0
         raise ServiceUnavailable(
             f"no replica available after {attempts} attempt(s) "
@@ -220,19 +249,31 @@ class FleetRouter:
             f"{len(self.registry.candidates())} healthy)",
             retry_after_s=retry_after)
 
-    def _passthrough_stream(self, resp, replica, content_type):
+    def _passthrough_stream(self, resp, replica, content_type, journey=None):
         """Byte-for-byte pass-through tied to the client connection: the
         Stream's on_close closes the upstream socket (propagating client
-        disconnect as upstream cancel) and releases in-flight."""
+        disconnect as upstream cancel) and releases in-flight. The
+        journey record observes the stream from here: first chunk stamps
+        TTFB, an upstream death goes terminal as stream_break, on_close
+        finishes the journey ok (a no-op when it already broke)."""
         router = self
+        journeys = self.journeys
 
         def chunks():
+            first = True
             try:
                 for chunk in resp.iter_chunks():
                     if chunk:
+                        if journeys is not None:
+                            if first:
+                                journeys.first_chunk(journey)
+                                first = False
+                            journeys.chunk(journey)
                         yield chunk
             except Exception as exc:  # noqa: BLE001 - upstream died mid-stream
                 router._count_stream_break(replica)
+                if journeys is not None:
+                    journeys.finish(journey, "stream_break", error=str(exc))
                 if router.logger is not None:
                     router.logger.errorf("fleet: stream from %s broke: %s",
                                          replica.name, exc)
@@ -244,6 +285,8 @@ class FleetRouter:
         def on_close():
             resp.close()
             replica.end()
+            if journeys is not None:
+                journeys.finish(journey, "ok")
 
         return Stream(chunks(), content_type=content_type, sse=False,
                       on_close=on_close)
@@ -257,6 +300,9 @@ class FleetRouter:
         snap = self.registry.snapshot()
         for row in snap["replicas"]:
             row["affinity_entries"] = self.affinity_map.entries_for(row["name"])
+        if self.journeys is not None:
+            snap["journeys"] = {"finished_total": self.journeys.finished_total,
+                                "capacity": self.journeys.capacity}
         return {
             "policy": self.policy.name,
             "retry_budget": self.retry_budget,

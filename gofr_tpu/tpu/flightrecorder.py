@@ -478,6 +478,21 @@ class FlightRecorder:
                         break
             return rec.detail() if rec is not None else None
 
+    def lookup_trace(self, trace_id: str) -> List[Dict[str, Any]]:
+        """Every record (live + done) sharing a W3C trace id, oldest
+        first. A trace can own several records on one recorder (retried
+        requests) and across recorders (the disagg prefill/decode halves
+        each record the same inbound trace) — the journey assembler
+        (tpu/journey.py, fleet/journey.py) stitches them by this key."""
+        if not trace_id:
+            return []
+        with self._lock:
+            records = [r for r in self._done if r.trace_id == trace_id]
+            records.extend(r for r in self._live.values()
+                           if r.trace_id == trace_id)
+            records.sort(key=lambda r: r.wall(r.enqueued_at))
+            return [r.detail() for r in records]
+
     def __len__(self) -> int:
         with self._lock:
             return len(self._live) + len(self._done)

@@ -39,7 +39,9 @@ _RECORD_ATTRS = ("increment_counter", "set_gauge", "record_histogram",
 _CONFIG_ATTRS = ("get", "get_or_default", "get_int", "get_float",
                  "get_bool")
 _CONFIG_KEY_RE = re.compile(r"^[A-Z][A-Z0-9_]*$")
-_DEBUG_ROUTE_RE = re.compile(r"^/debug/[a-z_]+$")
+# nested segments included: the fleet tier registers /debug/fleet/slo
+# (and /debug/journey/{id} strips to /debug/journey via the /{ split)
+_DEBUG_ROUTE_RE = re.compile(r"^/debug/[a-z_]+(?:/[a-z_]+)*$")
 
 METRIC_SCOPES = ("gofr_tpu/tpu/", "gofr_tpu/fleet/")
 ROUTE_SCOPES = ("gofr_tpu/app.py", "gofr_tpu/tpu/", "gofr_tpu/fleet/")

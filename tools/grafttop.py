@@ -1,0 +1,230 @@
+#!/usr/bin/env python3
+"""grafttop: live terminal view of a router-tier fleet.
+
+One screen, refreshed in place, answering the on-call glance questions
+in fleet order: is the fleet routable (replica table), is the budget
+burning (fleet SLO bars vs the page threshold, per-replica states), is
+the control plane shedding (QoS ladder per replica), and what did the
+last requests actually experience (recent journeys with attempts /
+TTFB / outcome). Everything comes from the operator surfaces the
+router and replicas already serve — `/debug/fleet`,
+`/debug/fleet/slo`, `/debug/journey`, and per-replica `/stats` +
+`/debug/qos` via the addresses the fleet snapshot advertises — so
+grafttop needs no credentials, no agents, and nothing but stdlib.
+
+Usage:
+    python tools/grafttop.py [--router http://127.0.0.1:9000]
+                             [--interval 2] [--count 0] [--once]
+                             [--plain] [--no-color]
+
+--once renders a single frame and exits (testable / scriptable);
+--plain skips the ANSI clear-screen so frames append (pipes, logs).
+Fetch failures degrade to an error line per surface — a restarting
+router must not kill the watcher.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.request
+
+BAR_WIDTH = 24
+PAGE_BURN = 14.4  # display scale: a full bar = the default page threshold
+
+
+def _get_json(url: str, timeout: float = 5.0) -> dict:
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        body = json.loads(resp.read().decode())
+    return body.get("data", body) if isinstance(body, dict) else body
+
+
+def fetch(router: str) -> dict:
+    """One poll: router surfaces + per-replica /stats and /debug/qos via
+    the addresses in the fleet snapshot. Every surface degrades to an
+    `<name>_error` key instead of raising."""
+    base = router.rstrip("/")
+    out: dict = {"t": time.time()}
+    for key, path in (("fleet", "/debug/fleet"),
+                      ("fleet_slo", "/debug/fleet/slo"),
+                      ("journeys", "/debug/journey"),
+                      ("qos", "/debug/qos")):
+        try:
+            out[key] = _get_json(base + path)
+        except Exception as exc:  # noqa: BLE001 - render what we have
+            out[key + "_error"] = str(exc)
+    replicas = (out.get("fleet") or {}).get("replicas", [])
+    stats: dict = {}
+    qos: dict = {}
+    for row in replicas:
+        name, addr = row.get("name"), row.get("address")
+        if not name or not addr:
+            continue
+        addr = addr.rstrip("/")
+        try:
+            stats[name] = _get_json(addr + "/stats")
+        except Exception as exc:  # noqa: BLE001
+            stats[name] = {"error": str(exc)}
+        try:
+            qos[name] = _get_json(addr + "/debug/qos")
+        except Exception:  # noqa: BLE001 - QOS=false replicas lack it
+            pass
+    out["replica_stats"] = stats
+    out["replica_qos"] = qos
+    return out
+
+
+def _bar(value, scale: float = PAGE_BURN, width: int = BAR_WIDTH) -> str:
+    if not isinstance(value, (int, float)) or scale <= 0:
+        return "-" * width
+    filled = min(width, int(round(width * min(1.0, value / scale))))
+    return "#" * filled + "." * (width - filled)
+
+
+def _fmt(value, nd: int = 2, unit: str = "") -> str:
+    if not isinstance(value, (int, float)):
+        return "-"
+    return f"{value:.{nd}f}{unit}"
+
+
+def _state_mark(state: str, color: bool) -> str:
+    mark = {"ok": "ok", "warn": "WARN", "page": "PAGE"}.get(state, state or "-")
+    if not color:
+        return mark
+    code = {"ok": "32", "warn": "33", "WARN": "33", "page": "31",
+            "PAGE": "31"}.get(mark, "0")
+    return f"\x1b[{code}m{mark}\x1b[0m"
+
+
+def render(data: dict, color: bool = False) -> str:
+    """One frame as a string (pure function of one fetch() result, so
+    tests can assert on it without a terminal)."""
+    lines: list = []
+    stamp = time.strftime("%H:%M:%S", time.localtime(data.get("t", 0)))
+    fleet = data.get("fleet") or {}
+    slo = data.get("fleet_slo") or {}
+    journeys = data.get("journeys") or {}
+
+    avail = fleet.get("available")
+    total = len(fleet.get("replicas", []))
+    lines.append(f"grafttop {stamp}  policy={fleet.get('policy', '-')}"
+                 f"  replicas={avail}/{total}"
+                 f"  retries={sum((fleet.get('retries') or {}).values())}"
+                 f"  stream_breaks={fleet.get('stream_breaks', '-')}"
+                 f"  hidden_pages={slo.get('hidden_pages', '-')}")
+    if "fleet_error" in data:
+        lines.append(f"  fleet: ERROR {data['fleet_error']}")
+
+    # -- replica table ------------------------------------------------------
+    lines.append("")
+    lines.append(f"  {'replica':10} {'state':9} {'brk':3} {'shed':4} "
+                 f"{'queue':5} {'slots':5} {'duty':5} {'infl':4} "
+                 f"{'breaks':6} {'slo':18}")
+    replica_slo = slo.get("replicas") or {}
+    for row in fleet.get("replicas", []):
+        name = row.get("name", "-")
+        states = replica_slo.get(name) or {}
+        worst = "-"
+        if isinstance(states, dict) and states and "error" not in states:
+            order = {"page": 2, "warn": 1, "ok": 0}
+            worst = max((s.get("state", "-") for s in states.values()),
+                        key=lambda s: order.get(s, -1))
+        stats = (data.get("replica_stats") or {}).get(name) or {}
+        lines.append(
+            f"  {name:10} {str(row.get('state', '-')):9} "
+            f"{'Y' if row.get('breaker_open') else '.':3} "
+            f"{'Y' if row.get('shedding') else '.':4} "
+            f"{str(row.get('queue_depth', '-')):5} "
+            f"{str(stats.get('active_slots', row.get('active_slots', '-'))):5} "
+            f"{_fmt(row.get('duty_cycle')):5} "
+            f"{str(row.get('inflight', '-')):4} "
+            f"{str(row.get('stream_breaks', '-')):6} "
+            f"{_state_mark(worst, color):18}")
+
+    # -- fleet SLO burn bars ------------------------------------------------
+    lines.append("")
+    if "fleet_slo_error" in data:
+        lines.append(f"  fleet slo: ERROR {data['fleet_slo_error']}")
+    else:
+        slos = (slo.get("fleet") or {}).get("slos") or {}
+        for name in sorted(slos):
+            track = slos[name]
+            windows = track.get("windows") or {}
+            fast = (windows.get("fast") or {}).get("burn_rate")
+            slow = (windows.get("slow") or {}).get("burn_rate")
+            lines.append(
+                f"  burn {name:13} fast [{_bar(fast)}] {_fmt(fast)}  "
+                f"slow [{_bar(slow)}] {_fmt(slow)}  "
+                f"{_state_mark(track.get('state'), color)}")
+        classes = slo.get("classes") or {}
+        if classes:
+            lines.append("  goodput " + "  ".join(
+                f"{cls}={_fmt(row.get('goodput'), 3)}"
+                for cls, row in sorted(classes.items())))
+
+    # -- QoS ladder (per replica that serves it) ----------------------------
+    ladders = []
+    for name, snap in sorted((data.get("replica_qos") or {}).items()):
+        ladder = (snap or {}).get("ladder") or {}
+        if ladder:
+            level = ladder.get("level_name", ladder.get("level", "-"))
+            ladders.append(f"{name}:{level}")
+    if ladders:
+        lines.append("  qos ladder " + "  ".join(ladders))
+
+    # -- recent journeys ----------------------------------------------------
+    lines.append("")
+    if "journeys_error" in data:
+        lines.append(f"  journeys: ERROR {data['journeys_error']}")
+    else:
+        lines.append(f"  journeys finished={journeys.get('finished_total', '-')}"
+                     f" in_flight={len(journeys.get('in_flight', []))}")
+        lines.append(f"  {'id':6} {'replica':10} {'outcome':14} {'att':3} "
+                     f"{'ttfb':8} {'stream':8} {'chunks':6}")
+        for j in (journeys.get("recent") or [])[:8]:
+            lines.append(
+                f"  {str(j.get('id', '-')):6} "
+                f"{str(j.get('replica', '-')):10} "
+                f"{str(j.get('outcome', '-')):14} "
+                f"{len(j.get('attempts', [])):<3} "
+                f"{_fmt(j.get('ttfb_s'), 3, 's'):8} "
+                f"{_fmt(j.get('stream_s'), 3, 's'):8} "
+                f"{str(j.get('chunks', '-')):6}")
+    return "\n".join(lines)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--router", default="http://127.0.0.1:9000",
+                    help="router HTTP base (serves /debug/fleet)")
+    ap.add_argument("--interval", type=float, default=2.0)
+    ap.add_argument("--count", type=int, default=0,
+                    help="frames before exiting; 0 = until interrupted")
+    ap.add_argument("--once", action="store_true",
+                    help="render one frame and exit (same as --count 1)")
+    ap.add_argument("--plain", action="store_true",
+                    help="no clear-screen between frames (pipes, logs)")
+    ap.add_argument("--no-color", action="store_true")
+    args = ap.parse_args()
+    count = 1 if args.once else args.count
+    color = (not args.no_color) and sys.stdout.isatty()
+    clear = "" if (args.plain or not sys.stdout.isatty()) else "\x1b[H\x1b[2J"
+
+    n = 0
+    try:
+        while True:
+            frame = render(fetch(args.router), color=color)
+            sys.stdout.write(clear + frame + "\n")
+            sys.stdout.flush()
+            n += 1
+            if count and n >= count:
+                return 0
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

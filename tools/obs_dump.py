@@ -22,6 +22,12 @@ trail, per-class queue/goodput/preemption counters, batch-lane depth),
 so soak artifacts gain efficiency, step-anatomy, error-budget, and
 QoS-control axes next to the tail evidence.
 
+Router-tier targets additionally contribute the journey plane: the
+`/debug/fleet/slo` rollup (fleet burn windows, per-replica SLO states,
+hidden-page count) and a `/debug/journey` digest with nearest-rank
+p50/p90/p99 over the ring's router-observed TTFB and stream duration —
+cross-hop tail evidence next to the per-replica kind.
+
 Usage:
     python tools/obs_dump.py [--server http://127.0.0.1:8000]
                              [--metrics http://127.0.0.1:2121]
@@ -51,6 +57,18 @@ SLO_GAUGES = ("app_tpu_slo_ttft_goodput", "app_tpu_slo_tpot_goodput",
 def _get(url: str, timeout: float = 5.0) -> str:
     with urllib.request.urlopen(url, timeout=timeout) as resp:
         return resp.read().decode()
+
+
+def _percentiles(values: list) -> dict:
+    """p50/p90/p99 by nearest-rank over a small sample (journey rings
+    are bounded, so sorting in-process is fine)."""
+    vals = sorted(v for v in values if isinstance(v, (int, float)))
+    if not vals:
+        return {}
+    def pick(q: float) -> float:
+        return vals[min(len(vals) - 1, int(q * (len(vals) - 1) + 0.5))]
+    return {"n": len(vals), "p50": pick(0.50), "p90": pick(0.90),
+            "p99": pick(0.99)}
 
 
 def scrape_gauges(metrics_base: str) -> dict:
@@ -173,6 +191,50 @@ def poll_once(server: str, metrics_base: str) -> dict:
         }
     except Exception as exc:  # noqa: BLE001 - only router-tier processes serve it
         entry["fleet_error"] = str(exc)
+    try:
+        body = json.loads(_get(server.rstrip("/") + "/debug/fleet/slo"))
+        snap = body.get("data", body)
+        # fleet burn + per-replica states carry the rollup signal; the
+        # disagreement case (fleet paging, replicas quiet) is the one a
+        # post-mortem greps for, so hidden_pages rides along
+        entry["fleet_slo"] = {
+            "fleet_states": snap.get("fleet_states"),
+            "fleet": {
+                name: {"state": slo.get("state"),
+                       "burn_fast": ((slo.get("windows") or {})
+                                     .get("fast") or {}).get("burn_rate"),
+                       "burn_slow": ((slo.get("windows") or {})
+                                     .get("slow") or {}).get("burn_rate")}
+                for name, slo in ((snap.get("fleet") or {})
+                                  .get("slos") or {}).items()},
+            "classes": snap.get("classes"),
+            "replicas": snap.get("replicas"),
+            "replicas_paging": snap.get("replicas_paging"),
+            "hidden_pages": snap.get("hidden_pages"),
+        }
+    except Exception as exc:  # noqa: BLE001 - only router-tier processes serve it
+        entry["fleet_slo_error"] = str(exc)
+    try:
+        body = json.loads(_get(server.rstrip("/") + "/debug/journey"))
+        snap = body.get("data", body)
+        recent = snap.get("recent", [])
+        # hop-latency percentiles over the ring: router-observed TTFB +
+        # stream duration are the cross-hop tail evidence a blown-p99
+        # soak is diagnosed from
+        entry["journeys"] = {
+            "finished_total": snap.get("finished_total"),
+            "in_flight": len(snap.get("in_flight", [])),
+            "ttfb_s": _percentiles([j.get("ttfb_s") for j in recent]),
+            "stream_s": _percentiles([j.get("stream_s") for j in recent]),
+            "outcomes": {
+                outcome: sum(1 for j in recent
+                             if j.get("outcome") == outcome)
+                for outcome in {j.get("outcome") for j in recent}
+                if outcome},
+            "recent": recent[:5],
+        }
+    except Exception as exc:  # noqa: BLE001 - journey plane off or absent
+        entry["journeys_error"] = str(exc)
     try:
         body = json.loads(_get(server.rstrip("/") + "/debug/qos"))
         snap = body.get("data", body)

@@ -545,8 +545,13 @@ def run_router(seconds: float, n_threads: int, preset: str) -> bool:
     fleet-wide: the router retries UNSTARTED requests onto the healthy
     replica, ejects the sick one, probes it back in) + the sick replica
     OBSERVED unavailable mid-run + recovered at the end + an affinity
-    hit rate in the evidence."""
+    hit rate in the evidence + journey completeness: every recent
+    journey must assemble into a cross-hop waterfall with ZERO orphan
+    hops (no missing replica payloads) even though one replica spent
+    the middle of the run breaker-open; the worst end-to-end waterfall
+    rides in the report."""
     import importlib.util
+    import tempfile
     import urllib.error
     import urllib.request
 
@@ -595,6 +600,8 @@ def run_router(seconds: float, n_threads: int, preset: str) -> bool:
             for i, a in enumerate(replicas)),
         "FLEET_PROBE_S": "0.5", "FLEET_AFFINITY_BLOCK": "24",
         "FLEET_RETRY_BUDGET": "3",
+        # hidden-burn bundles must not land in ./incidents from a tool run
+        "INCIDENT_DIR": tempfile.mkdtemp(prefix="soak_router_incidents_"),
     }))
     router_app.start()
     base = f"http://127.0.0.1:{router_app.http_port}"
@@ -727,6 +734,49 @@ def run_router(seconds: float, n_threads: int, preset: str) -> bool:
             final = json.loads(resp.read().decode())["data"]
     except Exception:  # noqa: BLE001
         pass
+    # journey audit (replicas must still be up: assembly fetches their
+    # hops live): every recent journey must assemble COMPLETE — router
+    # route/stream hops stitched to the committed replica's
+    # queue/prefill/decode hops by trace id — with zero orphans, even
+    # though r1 spent the chaos window breaker-open. The worst
+    # end-to-end waterfall is the report's exhibit.
+    journeys_checked = 0
+    journey_orphans = []
+    worst = None
+    try:
+        with urllib.request.urlopen(base + "/debug/journey",
+                                    timeout=10) as resp:
+            index = json.loads(resp.read().decode())["data"]
+        stats["journeys_finished_total"] = index.get("finished_total")
+        for row in index.get("recent", [])[:24]:
+            jid = row.get("id")
+            try:
+                with urllib.request.urlopen(
+                        base + f"/debug/journey/{jid}",
+                        timeout=10) as resp:
+                    assembled = json.loads(resp.read().decode())["data"]
+            except Exception as exc:  # noqa: BLE001 - an orphan, not a crash
+                journey_orphans.append({"id": jid,
+                                        "error": str(exc)[:120]})
+                continue
+            journeys_checked += 1
+            if not assembled.get("complete") or assembled.get("missing"):
+                journey_orphans.append(
+                    {"id": jid, "missing": assembled.get("missing")})
+                continue
+            total = (assembled.get("journey") or {}).get("total_s") or 0.0
+            if worst is None or total > worst[0]:
+                worst = (total, assembled)
+    except Exception as exc:  # noqa: BLE001 - absence of the plane = fail
+        journey_orphans.append({"error": str(exc)[:120]})
+    stats["journeys_checked"] = journeys_checked
+    if journey_orphans:
+        stats["journey_orphans"] = journey_orphans[:8]
+    if worst is not None:
+        stats["worst_journey"] = {
+            "total_s": worst[0],
+            "journey": worst[1].get("journey"),
+            "hops": worst[1].get("hops")}
     router_app.shutdown()
     for app in replicas:
         app.shutdown()
@@ -755,7 +805,8 @@ def run_router(seconds: float, n_threads: int, preset: str) -> bool:
                  and all(r["available"] for r in final["replicas"]))
     ok = (stats["errors"] == 0 and stats["shed"] == 0 and stats["ok"] > 0
           and sick_out_polls > 0 and recovered
-          and hit_rate is not None and hit_rate > 0)
+          and hit_rate is not None and hit_rate > 0
+          and journeys_checked > 0 and not journey_orphans)
     stats["pass"] = ok
     print(json.dumps(stats))
     return ok
