@@ -607,7 +607,10 @@ def run_phase_qos(n_requests=12, max_tokens=8, lane_jobs=8,
     {qos_interactive_ttft_quiet_ms, qos_interactive_ttft_saturated_ms,
     qos_interactive_ttft_protect_ms, qos_interactive_tpot_quiet_ms,
     qos_interactive_tpot_saturated_ms, qos_goodput_interactive,
-    qos_goodput_batch, qos_lane_completed}."""
+    qos_goodput_batch, qos_lane_completed} plus the capacity
+    observatory's measured μ/ρ and top-tenant attribution
+    (capacity_mu_tok_s, capacity_rho, capacity_top_tenant,
+    capacity_top_tenant_device_s — tpu/meter.py)."""
     import urllib.request
 
     from gofr_tpu.config import MockConfig
@@ -685,6 +688,14 @@ def run_phase_qos(n_requests=12, max_tokens=8, lane_jobs=8,
         classes = snap.get("classes") or {}
         goodput = {c: (classes.get(c) or {}).get("goodput")
                    for c in ("interactive", "batch")}
+        # capacity observatory readout rides along: the measured service
+        # rate μ + utilization ρ at the bench's batch shape, and the top
+        # tenant's attributed device time (tpu/meter.py)
+        body = json.loads(urllib.request.urlopen(
+            base + "/debug/capacity", timeout=10).read())
+        cap = body.get("data", body)
+        forecast = cap.get("forecast") or {}
+        top_tenants = cap.get("tenants") or []
         # let the lane drain so shutdown isn't tearing down live decodes
         drain_deadline = time.time() + 120.0
         while time.time() < drain_deadline and lane.depth() > 0:
@@ -702,7 +713,13 @@ def run_phase_qos(n_requests=12, max_tokens=8, lane_jobs=8,
                 round(tpot_sat, 2) if tpot_sat is not None else None),
             "qos_goodput_interactive": goodput["interactive"],
             "qos_goodput_batch": goodput["batch"],
-            "qos_lane_completed": completed}
+            "qos_lane_completed": completed,
+            "capacity_mu_tok_s": forecast.get("mu_tok_s"),
+            "capacity_rho": forecast.get("rho"),
+            "capacity_top_tenant": (top_tenants[0].get("tenant")
+                                    if top_tenants else None),
+            "capacity_top_tenant_device_s": (
+                top_tenants[0].get("device_s") if top_tenants else None)}
 
 
 class _Record:

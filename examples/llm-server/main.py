@@ -461,6 +461,11 @@ def build_app(config=None, engine=None) -> App:
     # legacy traffic that never asked for QoS semantics
     if app.config.get_bool("QOS", False):
         app.enable_qos(engine)
+    # capacity observatory: per-tenant attribution (app_tpu_meter_*) +
+    # headroom forecast (app_tpu_capacity_*) at GET /debug/capacity;
+    # CAPACITY=false opts out, METER_* / CAPACITY_* tune it
+    if app.config.get_bool("CAPACITY", True):
+        app.enable_capacity(engine)
     tokenizer: ByteTokenizer = engine.tokenizer
     # disaggregated pair (DISAGG_MODE=both): the router is the front door
     # — prefill pool runs the prompt, decode pool streams the rest — and

@@ -98,6 +98,10 @@ def build_app(**kw) -> App:
     # classes/quotas/shed ladder/batch lane + GET /debug/qos
     if app.config.get_bool("QOS", False):
         app.enable_qos(engine)
+    # capacity observatory (llm-server parity): GET /debug/capacity,
+    # app_tpu_meter_* / app_tpu_capacity_*; CAPACITY=false opts out
+    if app.config.get_bool("CAPACITY", True):
+        app.enable_capacity(engine)
     # disaggregated pair (DISAGG_MODE=both, llm-server parity): submits go
     # through the router's prefill/decode split; GET /debug/disagg
     router = getattr(engine, "disagg_router", None)

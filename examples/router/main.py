@@ -27,10 +27,14 @@ holds the handler until the replica finishes generating.
 import os
 
 from gofr_tpu import App
-from gofr_tpu.fleet import (FleetRouter, FleetSLO, JourneyRecorder,
-                            install_routes, register_fleet_metrics,
+from gofr_tpu.fleet import (FleetCapacity, FleetRouter, FleetSLO,
+                            JourneyRecorder, install_routes,
+                            register_fleet_capacity_metrics,
+                            register_fleet_metrics,
                             register_fleet_slo_metrics,
                             register_journey_metrics)
+from gofr_tpu.fleet.capacity import \
+    install_routes as install_fleet_capacity_routes
 from gofr_tpu.fleet.journey import install_routes as install_journey_routes
 from gofr_tpu.fleet.slo import install_routes as install_fleet_slo_routes
 
@@ -91,6 +95,21 @@ def build_app(config=None) -> App:
         # burn must DECAY while the router idles: re-evaluate at scrape
         app.container.add_scrape_hook("fleet_slo_burn",
                                       router.slo.burn.publish)
+    # fleet capacity rollup: merge every replica's /debug/capacity into
+    # GET /debug/fleet/capacity — fleet rho/headroom, per-tenant
+    # fleet-wide spend, and the replicas_needed recommendation the
+    # autoscaler reads (FLEET_CAPACITY=false opts out)
+    if app.config.get_bool("FLEET_CAPACITY", True):
+        if metrics is not None:
+            register_fleet_capacity_metrics(metrics)
+        router.capacity = FleetCapacity.from_config(
+            app.config, registry=router.registry, metrics=metrics,
+            logger=app.logger)
+        install_fleet_capacity_routes(app, router)
+        # gauge re-eval at scrape, the fleet burn idiom: the rollup's
+        # rho/replicas_needed must track probe reality while idle
+        app.container.add_scrape_hook("fleet_capacity",
+                                      router.capacity.publish)
     router.start()
     app.on_shutdown(router.stop)
     return app

@@ -559,6 +559,60 @@ class App:
         install_routes(self, controller, path)
         return controller
 
+    def enable_capacity(self, engine, path: str = "/debug/capacity"):
+        """Wire the capacity observatory (tpu/meter.py) onto an engine:
+        the TPUMeter attribution ledger (per-tenant / per-class /
+        per-phase device-seconds, analytic FLOPs, KV page-seconds and
+        queue wait, published as the app_tpu_meter_*_total counters) and
+        the HeadroomForecaster (admission-door λ, utilization-ledger μ,
+        ρ, headroom and the fluid TTFT forecast, published as the
+        app_tpu_capacity_* gauges with scrape-hook re-eval so they decay
+        when idle), served together at GET /debug/capacity. The fleet
+        twin — the router's /debug/fleet/capacity rollup with
+        replicas_needed — lives in gofr_tpu/fleet/capacity.py.
+
+        Config: METER_PAGE_TOKENS (KV page granularity for dense
+        engines; paged engines inherit the allocator's page size),
+        METER_WINDOW_S (bounded-window spend horizon, 300),
+        METER_REQUESTS (finished per-request rows retained, 512),
+        METER_TOP_K (tenants in the /debug/capacity table, 10);
+        CAPACITY_WINDOW_S (λ window, 60), CAPACITY_RHO_WARN (collapse
+        arm threshold, 0.85), CAPACITY_COLLAPSE_EVALS (consecutive
+        rising-queue evals before the warning fires, 3). Returns the
+        TPUMeter (forecaster rides on meter.forecaster)."""
+        from .tpu.meter import (HeadroomForecaster, TPUMeter,
+                                install_routes, register_meter_metrics)
+
+        cfg = self.config
+        metrics = self.container.metrics_manager
+        if metrics is not None:
+            register_meter_metrics(metrics)
+        # paged engines bill at the allocator's real page size; dense
+        # engines at a fixed accounting granularity
+        page_tokens = getattr(getattr(engine, "allocator", None),
+                              "page_size", None) \
+            or cfg.get_int("METER_PAGE_TOKENS", 16)
+        meter = TPUMeter(
+            cfg=getattr(engine, "cfg", None),
+            page_tokens=page_tokens,
+            window_s=cfg.get_float("METER_WINDOW_S", 300.0),
+            done_capacity=cfg.get_int("METER_REQUESTS", 512),
+            top_k=cfg.get_int("METER_TOP_K", 10),
+            metrics=metrics, logger=self.logger)
+        meter.forecaster = HeadroomForecaster(
+            engine=engine,
+            window_s=cfg.get_float("CAPACITY_WINDOW_S", 60.0),
+            rho_warn=cfg.get_float("CAPACITY_RHO_WARN", 0.85),
+            collapse_evals=cfg.get_int("CAPACITY_COLLAPSE_EVALS", 3),
+            metrics=metrics, logger=self.logger)
+        engine.meter = meter
+        # gauge re-eval at scrape, the utilization/burn idiom: an idle
+        # replica's λ window drains so rho/headroom decay to zero
+        self.container.add_scrape_hook("capacity",
+                                       meter.forecaster.publish)
+        install_routes(self, meter, path)
+        return meter
+
     # -- cross-cutting registrations ------------------------------------------
     def add_http_service(self, name: str, address: str, *options) -> None:
         from .service import new_http_service

@@ -29,6 +29,7 @@ the failure matrix.
 """
 
 from .affinity import AffinityMap, AffinityRecorder, affinity_keys
+from .capacity import FleetCapacity, register_fleet_capacity_metrics
 from .debug import register_fleet_metrics
 from .journey import JourneyRecorder, register_journey_metrics
 from .policy import (AffinityPolicy, P2CPolicy, RoundRobinPolicy,
@@ -44,4 +45,5 @@ __all__ = [
     "Replica", "register_fleet_metrics",
     "JourneyRecorder", "register_journey_metrics",
     "FleetBurnEngine", "FleetSLO", "register_fleet_slo_metrics",
+    "FleetCapacity", "register_fleet_capacity_metrics",
 ]

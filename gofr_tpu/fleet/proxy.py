@@ -61,9 +61,11 @@ class FleetRouter:
         self.class_sheds = {}
         # observability plane, attached by the router app (None-guarded
         # on every touch so the forwarding path never depends on it):
-        # journeys = fleet/journey.py recorder, slo = fleet/slo.py rollup
+        # journeys = fleet/journey.py recorder, slo = fleet/slo.py
+        # rollup, capacity = fleet/capacity.py rollup
         self.journeys = None
         self.slo = None
+        self.capacity = None
 
     @classmethod
     def from_config(cls, config, logger=None, metrics=None):

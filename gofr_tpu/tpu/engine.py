@@ -700,6 +700,13 @@ class LLMEngine:
         # a QoSController — same zero-overhead contract as the planes
         # above (one attribute check per submit / admission round)
         self.qos = None
+        # capacity observatory (tpu/meter.py): None unless
+        # App.enable_capacity wires a TPUMeter — same zero-overhead
+        # contract. _meter_rows stages one sync's batch rows (loop-thread
+        # only) until _finish_step closes the step ledger record whose
+        # segment timings the meter apportions
+        self.meter = None
+        self._meter_rows = None
         # crash-only recovery: replay-after-reset budget + reset-storm
         # breaker (tpu/faults.py). Active requests survive a device reset
         # by re-admitting at prompt+emitted with elevated priority; the
@@ -970,6 +977,14 @@ class LLMEngine:
     def wedged(self) -> bool:
         return self._stall_over_threshold() > 0.0
 
+    def queue_depth(self) -> int:
+        """Requests waiting for a slot — thread-safe; the capacity
+        forecaster's backlog input (tpu/meter.py). The loop merges
+        _pending into the admission heap every round, so the heap IS
+        the backlog most of the time — counting only _pending would
+        report ~0 while requests pile up parked on slots or pages."""
+        return self._pending.qsize() + len(self._admission_heap)
+
     def health_check(self):
         """Container health contributor (container.add_health_contributor):
         DEGRADED once the loop stalls past the shed threshold. DEGRADED,
@@ -980,7 +995,7 @@ class LLMEngine:
 
         details = {
             "active_slots": sum(1 for s in self.slots if s.active),
-            "queue_depth": self._pending.qsize(),
+            "queue_depth": self.queue_depth(),
         }
         if self.breaker.blocked():
             # reset storm: DOWN, not DEGRADED — there is no in-flight work
@@ -1075,13 +1090,17 @@ class LLMEngine:
         self._obs.counter("app_tpu_requests_total")
         if self.qos is not None:
             self.qos.note_submitted(request)
+        if self.meter is not None:
+            # admission-door arrival stamp (tpu/meter.py): feeds the
+            # forecaster's λ window — thread-safe, best-effort
+            self.meter.note_arrival(request)
         self._pending.put((request.priority, request.id, request))
         if self._stop.is_set():
             # stop() may have drained _pending between the check above and
             # the put; drain again so this request cannot strand its client
             self._drain_pending(RuntimeError("engine stopped"))
             raise RuntimeError("engine is stopped")
-        self._obs.gauge("app_tpu_queue_depth", self._pending.qsize())
+        self._obs.gauge("app_tpu_queue_depth", self.queue_depth())
         self._wake.set()
         return request
 
@@ -1193,7 +1212,7 @@ class LLMEngine:
         if self._stop.is_set():
             self._drain_pending(RuntimeError("engine stopped"))
             raise RuntimeError("engine is stopped")
-        self._obs.gauge("app_tpu_queue_depth", self._pending.qsize())
+        self._obs.gauge("app_tpu_queue_depth", self.queue_depth())
         self._wake.set()
         return request
 
@@ -2099,7 +2118,15 @@ class LLMEngine:
         rec = self.steps.step_end(
             active_slots=sum(1 for s in self.slots if s.active),
             inflight=len(self._inflight),
-            queue_depth=self._pending.qsize())
+            queue_depth=self.queue_depth())
+        staged, self._meter_rows = self._meter_rows, None
+        if self.meter is not None and staged is not None and rec is not None:
+            # attribution happens HERE, not at the sync site: the step
+            # ledger record just closed, so the meter apportions the
+            # step's measured device segments — conservation against
+            # /debug/steps is exact by construction (tpu/meter.py)
+            phase, rows, queued = staged
+            self.meter.account_step(rec, phase, rows, queued)
         if rec is not None and rec.straggler:
             if self.recorder is not None:
                 self.recorder.record_engine_event(
@@ -2352,7 +2379,7 @@ class LLMEngine:
                     self._fail_request(request, exc)
             raise
 
-        self._obs.gauge("app_tpu_queue_depth", self._pending.qsize())
+        self._obs.gauge("app_tpu_queue_depth", self.queue_depth())
         self._obs.gauge("app_tpu_active_slots",
                         sum(1 for s in self.slots if s.active))
 
@@ -2626,6 +2653,17 @@ class LLMEngine:
             self.steps.note_sync(
                 "prefill", tokens=len(admitted),
                 slowest_request_id=slowest.id if slowest else None)
+            if self.meter is not None:
+                # stage the synced batch for _finish_step's attribution:
+                # every dispatched row is billed (a cancel between
+                # dispatch and sync still consumed the device), and rows
+                # awaiting their first token carry their queue wait
+                self._meter_rows = (
+                    "prefill",
+                    [(r, len(r.resume_tokens), len(r.resume_tokens))
+                     for _, r in admitted],
+                    [(r, dispatched_at - r.enqueued_at)
+                     for _, r in admitted if r.first_token_at is None])
             n_first = 0
             for row, (slot_idx, request) in enumerate(admitted):
                 slot = self.slots[slot_idx]
@@ -2690,6 +2728,13 @@ class LLMEngine:
             # pre-demux deepest context: the lock-step batch's cost driver
             slowest = max(live, key=lambda e: self.slots[e[0]].length,
                           default=(None, None))[1]
+            if self.meter is not None:
+                # d+1 positions scored per live row; kv context read
+                # pre-demux (the lengths this dispatch actually touched)
+                self._meter_rows = (
+                    "verify",
+                    [(r, d + 1, self.slots[i].length) for i, r in live],
+                    None)
             self._obs.hist("app_tpu_execute_seconds", elapsed)
             emitted = 0
             n_active = len(live)
@@ -2779,6 +2824,14 @@ class LLMEngine:
         # pre-demux deepest context: the lock-step batch's cost driver
         slowest = max(live, key=lambda e: self.slots[e[0]].length,
                       default=(None, None))[1]
+        if self.meter is not None:
+            # block positions computed per live row regardless of how
+            # many tokens the demux later emits (stops truncate emission,
+            # not device work); kv context read pre-demux
+            self._meter_rows = (
+                "decode",
+                [(r, block, self.slots[i].length) for i, r in live],
+                None)
 
         n_active = len(live)
         emitted = 0
@@ -2856,6 +2909,9 @@ class LLMEngine:
         if not handled:
             if self.qos is not None:
                 self.qos.note_finished(request, ok=request.error is None)
+            if self.meter is not None:
+                self.meter.note_finished(request,
+                                         ok=request.error is None)
             request.out_queue.put(None)
 
     @loop_only
@@ -3000,6 +3056,9 @@ class LLMEngine:
                 self.recorder.record_finished(request, reason)
             if self.qos is not None:
                 self.qos.note_finished(request, ok=request.error is None)
+            if self.meter is not None:
+                self.meter.note_finished(request,
+                                         ok=request.error is None)
             self._obs.gauge("app_tpu_active_slots", active_now)
             request.out_queue.put(None)
         return job

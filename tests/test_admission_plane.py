@@ -56,6 +56,7 @@ def _wait_shadows(shadows, n, timeout_s=120.0):
         f"finished={[s.finished_at is not None for s in shadows]}")
 
 
+@pytest.mark.slow  # tier-1 wall-clock budget; lighter in-lane representative kept
 def test_live_traffic_follower_matches_leader_and_oracle():
     oracle = _engine()
     oracle.start()
@@ -125,6 +126,7 @@ def test_cancel_takes_effect_on_the_same_wave_everywhere():
         follower.stop()
 
 
+@pytest.mark.slow  # tier-1 wall-clock budget; lighter in-lane representative kept
 def test_drain_rides_a_wave_and_fails_parked_requests_on_every_rank():
     leader, follower, shadows = _pair(InProcKV())
     follower.start()
@@ -158,6 +160,7 @@ def test_drain_rides_a_wave_and_fails_parked_requests_on_every_rank():
         follower.stop()
 
 
+@pytest.mark.slow  # tier-1 wall-clock budget; lighter in-lane representative kept
 def test_cancel_frees_capacity_when_saturated():
     """With ALL slots busy no admission can happen — but the wave exchange
     must still run, or cancels would never sync and a saturated server
@@ -203,6 +206,7 @@ def test_leader_stop_mid_generation_stops_follower():
     assert shadows[0].error is not None  # failed loudly, not stranded
 
 
+@pytest.mark.slow  # tier-1 wall-clock budget; lighter in-lane representative kept
 def test_parked_requests_admit_after_all_slots_finish_together():
     """Deadlock regression: 6 equal-budget requests on 4 slots — all four
     actives finish in the SAME decode block, so the next iteration has no

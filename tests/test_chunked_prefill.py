@@ -46,7 +46,11 @@ PROMPTS = [
 ]
 
 
-@pytest.mark.parametrize("cls", ENGINES)
+@pytest.mark.parametrize("cls", [
+    LLMEngine,
+    # tier-1 wall-clock budget: dense variant stays as the in-lane rep
+    pytest.param(PagedLLMEngine, marks=pytest.mark.slow),
+])
 def test_chunked_matches_fused_token_for_token(cls):
     fused = _make(chunk=0)
     try:
@@ -64,6 +68,7 @@ def test_chunked_matches_fused_token_for_token(cls):
     assert got == want
 
 
+@pytest.mark.slow  # tier-1 wall-clock budget; lighter in-lane representative kept
 @pytest.mark.parametrize("cls", ENGINES)
 def test_chunked_admission_during_active_decode(cls):
     """A chunked admission lands while another request is mid-decode: the

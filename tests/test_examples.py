@@ -525,6 +525,7 @@ def test_openai_server_sampling_params_honored_or_rejected():
         app.shutdown()
 
 
+@pytest.mark.slow  # tier-1 wall-clock budget; lighter in-lane representative kept
 def test_pubsub_worker_tp_sharded_end_to_end():
     """BASELINE config 5's full composition in ONE flow: durable broker
     ingress -> TENSOR-PARALLEL sharded engine (tp mesh over the virtual

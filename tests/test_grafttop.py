@@ -1,0 +1,158 @@
+"""grafttop render() tests: the frame is a pure function of one
+fetch() payload, so every panel — replica table, fleet SLO burn, QoS
+ladder, capacity, journeys — is assertable as substrings, including
+the degraded (missing-endpoint) and narrow-terminal shapes."""
+
+import importlib.util
+import os
+
+import pytest
+
+pytestmark = pytest.mark.capacity
+
+_PATH = os.path.join(os.path.dirname(__file__), "..", "tools",
+                     "grafttop.py")
+_spec = importlib.util.spec_from_file_location("grafttop_under_test",
+                                               _PATH)
+grafttop = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(grafttop)
+
+
+def _payload():
+    """One healthy fetch() result covering every panel."""
+    return {
+        "t": 1700000000.0,
+        "fleet": {
+            "policy": "prefix", "available": 2,
+            "retries": {"unstarted": 3},
+            "stream_breaks": 1,
+            "replicas": [
+                {"name": "r0", "address": "http://x:1", "state": "up",
+                 "breaker_open": False, "shedding": False,
+                 "queue_depth": 2, "duty_cycle": 0.61, "inflight": 3,
+                 "stream_breaks": 0},
+                {"name": "r1", "address": "http://x:2", "state": "ejected",
+                 "breaker_open": True, "shedding": True,
+                 "queue_depth": 9, "duty_cycle": 0.98, "inflight": 7,
+                 "stream_breaks": 1},
+            ],
+        },
+        "fleet_slo": {
+            "hidden_pages": 0,
+            "fleet": {"slos": {"ttft": {
+                "windows": {"fast": {"burn_rate": 2.0},
+                            "slow": {"burn_rate": 0.5}},
+                "state": "warn"}}},
+            "classes": {"interactive": {"goodput": 0.999}},
+            "replicas": {"r0": {"ttft": {"state": "ok"}},
+                         "r1": {"ttft": {"state": "page"}}},
+        },
+        "capacity": {
+            "fleet": {"rho": 0.82, "headroom_tok_s": 360.0,
+                      "lambda_tok_s": 1640.0, "mu_tok_s": 2000.0,
+                      "replicas_needed": 3, "replicas_total": 2,
+                      "collapse_warnings": ["r1"]},
+            "tenants": [{"tenant": "acme", "device_s": 12.5},
+                        {"tenant": "zeta", "device_s": 0.75}],
+            "replicas": {"r0": {"rho": 0.7, "collapse_warning": False},
+                         "r1": {"rho": 0.97, "collapse_warning": True},
+                         "r2": {"error": "connection refused"}},
+        },
+        "journeys": {
+            "finished_total": 41, "in_flight": [1],
+            "recent": [{"id": 41, "replica": "r0", "outcome": "ok",
+                        "attempts": [{}], "ttfb_s": 0.123,
+                        "stream_s": 1.5, "chunks": 12}],
+        },
+        "qos": {},
+        "replica_stats": {"r0": {"active_slots": 3},
+                          "r1": {"error": "timeout"}},
+        "replica_qos": {"r0": {"ladder": {"level_name": "normal"}},
+                        "r1": {"ladder": {"level_name": "shed_batch"}}},
+    }
+
+
+def test_render_full_frame_covers_every_panel():
+    frame = grafttop.render(_payload())
+    # header
+    assert "policy=prefix" in frame
+    assert "replicas=2/2" in frame
+    assert "retries=3" in frame
+    # replica table: both rows, breaker/shed marks, worst SLO state
+    assert "r0" in frame and "r1" in frame
+    assert "ejected" in frame
+    assert "PAGE" in frame and "ok" in frame
+    # fleet SLO burn bars + class goodput
+    assert "burn ttft" in frame
+    assert "interactive=0.999" in frame
+    # QoS ladder per replica
+    assert "qos ladder" in frame
+    assert "r0:normal" in frame and "r1:shed_batch" in frame
+    # capacity panel: rho bar, headroom, autoscaler hand-off, collapse
+    assert "capacity rho" in frame
+    assert "0.82" in frame
+    assert "headroom=360tok/s" in frame
+    assert "need=3/2 replicas" in frame
+    assert "COLLAPSE" in frame
+    assert "acme=12.50s" in frame
+    assert "r1:0.97!" in frame          # per-replica collapse mark
+    assert "r2:ERR" in frame            # dead replica degrades in place
+    # journeys
+    assert "journeys finished=41 in_flight=1" in frame
+    assert "0.123s" in frame
+
+
+def test_render_degrades_per_missing_surface():
+    """A router that serves /debug/fleet but nothing else must still
+    render — one ERROR line per absent surface, no exception."""
+    data = {
+        "t": 0,
+        "fleet": {"policy": "rr", "available": 1,
+                  "replicas": [{"name": "r0", "address": "http://x:1",
+                                "state": "up"}]},
+        "fleet_slo_error": "HTTP Error 404: Not Found",
+        "capacity_error": "HTTP Error 404: Not Found",
+        "journeys_error": "timed out",
+        "replica_stats": {}, "replica_qos": {},
+    }
+    frame = grafttop.render(data)
+    assert "fleet slo: ERROR HTTP Error 404" in frame
+    assert "capacity: ERROR HTTP Error 404" in frame
+    assert "journeys: ERROR timed out" in frame
+    assert "r0" in frame
+
+
+def test_render_empty_payload_is_total():
+    frame = grafttop.render({"t": 0})
+    assert "grafttop" in frame
+    assert "replicas=None/0" in frame or "replicas" in frame
+
+
+def test_render_width_truncates_plain_lines():
+    frame = grafttop.render(_payload(), width=40)
+    for line in frame.splitlines():
+        assert len(line) <= 40, line
+    # the panels survive truncation (prefixes intact)
+    assert "capacity rho" in frame
+    assert "grafttop" in frame
+
+
+def test_render_width_leaves_ansi_lines_whole():
+    """Color frames carry cursor-safe escapes; truncation must never
+    cut one mid-sequence, so ANSI-bearing lines are left whole."""
+    frame = grafttop.render(_payload(), color=True, width=40)
+    ansi_lines = [ln for ln in frame.splitlines() if "\x1b" in ln]
+    assert ansi_lines, "color frame rendered no ANSI lines"
+    for line in ansi_lines:
+        assert line.count("\x1b[") % 2 == 0   # open+reset pairs intact
+    # plain lines still obey the width
+    for line in frame.splitlines():
+        if "\x1b" not in line:
+            assert len(line) <= 40
+
+
+def test_bar_and_fmt_handle_non_numeric():
+    assert grafttop._bar(None) == "-" * grafttop.BAR_WIDTH
+    assert grafttop._bar(99.0, scale=1.0) == "#" * grafttop.BAR_WIDTH
+    assert grafttop._fmt(None) == "-"
+    assert grafttop._fmt(0.5, 1, "s") == "0.5s"

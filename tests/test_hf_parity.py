@@ -91,6 +91,7 @@ def test_logits_match_transformers(tmp_path, tie):
     np.testing.assert_allclose(got, ref, rtol=1e-3, atol=2e-4)
 
 
+@pytest.mark.slow  # tier-1 wall-clock budget; lighter in-lane representative kept
 def test_greedy_continuation_matches_transformers(tmp_path):
     """Teacher-forced parity can hide compounding drift; greedy decode is
     the serving-shaped claim: both stacks produce the same continuation."""

@@ -18,6 +18,8 @@ import os
 import threading
 import time
 
+import pytest
+
 EXAMPLES = os.path.join(os.path.dirname(__file__), "..", "examples")
 
 
@@ -86,6 +88,7 @@ def _stream_one(port: int, prompt: str, max_tokens: int, out: dict):
         out["error"] = f"{type(exc).__name__}: {exc}"
 
 
+@pytest.mark.slow  # tier-1 wall-clock budget; lighter in-lane representative kept
 def test_64_concurrent_sse_streams_zero_errors():
     module = _load_llm_server()
     app = module.build_app(config=_cfg())

@@ -108,6 +108,7 @@ def test_two_process_mesh_executes_cross_host_reduction():
     assert "total=48.0" in outs[0][1] and "total=48.0" in outs[1][1]
 
 
+@pytest.mark.slow  # tier-1 wall-clock budget; lighter in-lane representative kept
 def test_bad_coordinator_fails_boot_loudly():
     """A worker pointed at a dead coordinator must error out within the
     configured timeout — not hang the boot forever."""
@@ -168,6 +169,7 @@ def test_two_process_live_traffic_admission_mirrors_leader():
     assert line0.split("checksum=")[1] == line1.split("checksum=")[1]
 
 
+@pytest.mark.slow  # tier-1 wall-clock budget; lighter in-lane representative kept
 def test_two_process_tp_serving_matches_single_device():
     """BASELINE config 5's DCN story executed: the serving engine runs
     TP=2 with its two shards in DIFFERENT processes (per-layer Megatron

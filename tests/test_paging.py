@@ -265,6 +265,7 @@ def test_paged_engine_streaming_and_stop_tokens():
         eng.stop()
 
 
+@pytest.mark.slow  # tier-1 wall-clock budget; lighter in-lane representative kept
 def test_paged_q8_engine_matches_paged_fp_closely():
     """INT8 paged pool: prefill is full-precision into the quantized splice
     (first token exact vs the fp paged engine); decode reads dequant-folded

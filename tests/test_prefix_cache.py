@@ -173,6 +173,7 @@ def test_prefix_composes_with_chunked_prefill():
         eng.stop()
 
 
+@pytest.mark.slow  # tier-1 wall-clock budget; lighter in-lane representative kept
 def test_prefix_composes_with_int8_pool():
     """int8 pools share scale pages alongside value pages: the hit path
     dequantizes the gathered rows (donor quantization preserved) and the
@@ -234,6 +235,7 @@ def test_warmup_precompiles_prefix_program():
         eng.stop()
 
 
+@pytest.mark.slow  # tier-1 wall-clock budget; lighter in-lane representative kept
 def test_prefix_composes_with_tp_mesh():
     """The config-5 default stack: paged pool sharded over a tp mesh WITH
     the prefix cache on. The tail-only program's gather/scatter must ride

@@ -48,12 +48,14 @@ def test_q8_engine_serves_and_matches_fp_closely():
     assert agree / total > 0.7, f"only {agree}/{total} tokens agree"
 
 
+@pytest.mark.slow  # tier-1 wall-clock budget; lighter in-lane representative kept
 def test_q8_engine_deterministic():
     a = _serve(CFG_Q8, PROMPTS)
     b = _serve(CFG_Q8, PROMPTS)
     assert a == b
 
 
+@pytest.mark.slow  # tier-1 wall-clock budget; lighter in-lane representative kept
 def test_q8_engine_grows_cache():
     """Admission past the boot allocation forces a q8 grow (values AND
     scales pad together)."""
@@ -81,6 +83,7 @@ def test_q8_requires_kernel_decode():
                   n_slots=2, max_seq_len=64, prefill_buckets=(8,))
 
 
+@pytest.mark.slow  # tier-1 wall-clock budget; lighter in-lane representative kept
 def test_q8_chunked_prefill_matches_fused():
     """Chunked admission over the int8 cache: same lengths and (near) the
     fused-q8 tokens. Exact equality is not guaranteed for multi-chunk
@@ -112,6 +115,7 @@ def test_q8_chunked_prefill_matches_fused():
     assert agree / total > 0.6, f"only {agree}/{total} tokens agree"
 
 
+@pytest.mark.slow  # tier-1 wall-clock budget; lighter in-lane representative kept
 def test_q8_engine_tp_mesh_matches_single_device():
     """int8 KV under a tp mesh: values shard KV heads (kv_cache_layer_spec),
     scales shard alongside (kv_scale_layer_spec); greedy decode must match

@@ -227,6 +227,7 @@ def test_class_ordered_admission_under_contention():
 
 # -- engine integration: preemption with replay -------------------------------
 
+@pytest.mark.slow  # tier-1 wall-clock budget; lighter in-lane representative kept
 def test_preempted_batch_matches_golden_tokens():
     """Ladder level 2 preempts a running batch decode mid-stream; after
     recovery it replays from prompt + emitted and the final token stream

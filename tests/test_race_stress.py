@@ -10,6 +10,8 @@ token leakage). Failures here are race symptoms even without a sanitizer.
 import threading
 import time
 
+import pytest
+
 import numpy as np
 
 from gofr_tpu.config import MockConfig
@@ -174,6 +176,7 @@ def test_executor_concurrent_compile_single_program():
                                   np.full((4,), 2.0))
 
 
+@pytest.mark.slow  # tier-1 wall-clock budget; lighter in-lane representative kept
 def test_spec_engine_concurrent_submit_cancel():
     """The speculative engine's extra host state (histories, EMA, cooloff)
     under the same hammering."""
@@ -387,6 +390,7 @@ def test_engine_stop_with_wedged_loop_leaves_state_to_live_loop():
     eng.stop()  # now a clean no-op drain
 
 
+@pytest.mark.slow  # tier-1 wall-clock budget; lighter in-lane representative kept
 def test_prefix_cache_engine_concurrent_submit_cancel():
     """Prefix-cache bookkeeping (match refs, owner-insert, leaf-first
     eviction under pool pressure, unref at finish AND at cancel-abort)
@@ -412,6 +416,7 @@ def test_prefix_cache_engine_concurrent_submit_cancel():
         cls=PagedLLMEngine, on_done=assert_no_leaks)
 
 
+@pytest.mark.slow  # tier-1 wall-clock budget; lighter in-lane representative kept
 def test_paged_engine_tiered_kv_concurrent_submit_cancel():
     """Spill/restore racing the submit/stream/cancel hammer: prompts
     DIVERGE in the first page so every one caches its own full pages, and
@@ -441,6 +446,7 @@ def test_paged_engine_tiered_kv_concurrent_submit_cancel():
         cls=PagedLLMEngine, on_done=assert_no_leaks_and_spilled)
 
 
+@pytest.mark.slow  # tier-1 wall-clock budget; lighter in-lane representative kept
 def test_wedge_recovery_races_concurrent_submitters():
     """Submitters racing wedge onset and recovery: every request must end
     terminal (tokens, EngineStalledError shed, or a cancel) — no client

@@ -101,7 +101,11 @@ def test_controls_engine_greedy_parity():
     assert _serve(controls=True) == _serve(controls=False)
 
 
-@pytest.mark.parametrize("cls", [LLMEngine, PagedLLMEngine])
+@pytest.mark.parametrize("cls", [
+    LLMEngine,
+    # tier-1 wall-clock budget: dense variant stays as the in-lane rep
+    pytest.param(PagedLLMEngine, marks=pytest.mark.slow),
+])
 def test_top_k_one_matches_greedy_end_to_end(cls):
     """temperature 1.0 + top_k=1 leaves one survivor per step: the served
     tokens must equal the greedy run's token-for-token, on both engines."""
@@ -111,6 +115,7 @@ def test_top_k_one_matches_greedy_end_to_end(cls):
     assert _serve(cls=cls, submits=sub) == want
 
 
+@pytest.mark.slow  # tier-1 wall-clock budget; lighter in-lane representative kept
 def test_tiny_top_p_matches_greedy_end_to_end():
     want = _serve(controls=False)
     sub = [{"max_new_tokens": 10, "temperature": 0.8, "top_p": 1e-4}

@@ -302,6 +302,7 @@ def _get(url, headers=None):
             resp.read().decode()
 
 
+@pytest.mark.slow  # tier-1 wall-clock budget; lighter in-lane representative kept
 def test_debug_steps_and_exemplar_drill_e2e():
     """The whole loop on the example server: serve a request, read
     /debug/steps, scrape OpenMetrics, follow a TTFT exemplar's request id

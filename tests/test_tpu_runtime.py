@@ -317,6 +317,7 @@ def test_engine_rejects_bad_prompts(engine):
         engine.submit(list(range(100)))  # exceeds largest prefill bucket (16)
 
 
+@pytest.mark.slow  # tier-1 wall-clock budget; lighter in-lane representative kept
 def test_engine_pipelined_matches_synchronous():
     """block=4/depth=3 pipelined engine emits the same greedy tokens as the
     fully synchronous block=1/depth=1 configuration, including under fused
@@ -343,6 +344,7 @@ def test_engine_pipelined_matches_synchronous():
     assert run(1, 1) == run(4, 3)
 
 
+@pytest.mark.slow  # tier-1 wall-clock budget; lighter in-lane representative kept
 def test_stream_ordering_with_cancels_mid_block():
     """Batched emission contract: with block-sized queue entries, pipelined
     dispatches and cancels landing mid-block, every client still receives
@@ -464,6 +466,7 @@ def test_engine_batch_id_trace_correlation():
     assert gen.end_time is not None
 
 
+@pytest.mark.slow  # tier-1 wall-clock budget; lighter in-lane representative kept
 def test_engine_flash_prefill_matches_xla():
     """attn_impl="flash" routes serving prefill through the Pallas kernel
     (full-window T == S case); greedy tokens must match the dense path."""
@@ -635,6 +638,7 @@ def test_priority_admission_order():
         eng.stop()
 
 
+@pytest.mark.slow  # tier-1 wall-clock budget; lighter in-lane representative kept
 def test_min_tokens_suppresses_early_stop():
     """stop_tokens are ignored until min_tokens have been emitted; without
     the floor the same stop set ends generation earlier."""

@@ -315,11 +315,22 @@ def test_metric_inventory_consistency():
     # tpu/qos.py's recording style)
     assert any(n.startswith("app_tpu_qos_") for n in recorded), \
         "qos plane counters vanished from the inventory scan"
+    # the capacity observatory families must be IN the scan (guards
+    # scanner rot against tpu/meter.py's batched-delta recording style)
+    assert any(n.startswith("app_tpu_meter_") for n in recorded), \
+        "meter attribution counters vanished from the inventory scan"
+    assert any(n.startswith("app_tpu_capacity_") for n in recorded), \
+        "capacity forecast gauges vanished from the inventory scan"
 
-    from gofr_tpu.fleet import register_fleet_metrics
+    from gofr_tpu.fleet import (register_fleet_capacity_metrics,
+                                register_fleet_metrics,
+                                register_fleet_slo_metrics,
+                                register_journey_metrics)
     from gofr_tpu.tpu.device import TPUClient
     from gofr_tpu.tpu.disagg import register_disagg_metrics
     from gofr_tpu.tpu.flightrecorder import register_slo_gauges
+    from gofr_tpu.tpu.incidents import register_incident_metrics
+    from gofr_tpu.tpu.meter import register_meter_metrics
     from gofr_tpu.tpu.qos import register_qos_metrics
     from gofr_tpu.tpu.stepledger import register_step_metrics
 
@@ -332,7 +343,12 @@ def test_metric_inventory_consistency():
     register_step_metrics(manager)  # idempotent next to register_metrics
     register_disagg_metrics(manager)
     register_fleet_metrics(manager)
+    register_fleet_slo_metrics(manager)
+    register_fleet_capacity_metrics(manager)
+    register_journey_metrics(manager)
+    register_incident_metrics(manager)
     register_qos_metrics(manager)
+    register_meter_metrics(manager)
     registered = set(manager._store)
     missing = recorded - registered
     assert not missing, (
@@ -368,7 +384,8 @@ def test_debug_endpoint_inventory_documented():
     for expected in ("/debug/profile", "/debug/requests", "/debug/engine",
                      "/debug/steps", "/debug/faults", "/debug/slo",
                      "/debug/incidents", "/debug/disagg", "/debug/fleet",
-                     "/debug/qos"):
+                     "/debug/qos", "/debug/capacity",
+                     "/debug/fleet/capacity"):
         assert expected in routes, f"scan missed {expected} (scanner rot?)"
 
     docs = os.path.join(os.path.dirname(__file__), "..", "docs",

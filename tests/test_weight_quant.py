@@ -149,6 +149,7 @@ def test_engine_plan_uses_actual_quantized_bytes():
     assert eng.plan.params_bytes < CFG.param_count() * 2
 
 
+@pytest.mark.slow  # tier-1 wall-clock budget; lighter in-lane representative kept
 def test_quantized_tp_mesh_matches_single_device():
     """int8 weights under a tp mesh: scale vectors shard with their weight's
     output axis (serving_param_specs(quantized=True)); the int32 dot
@@ -179,6 +180,7 @@ def test_quantized_tp_mesh_matches_single_device():
     assert serve(mesh) == serve(None)
 
 
+@pytest.mark.slow  # tier-1 wall-clock budget; lighter in-lane representative kept
 def test_quantized_composes_with_int8_kv():
     """Weight quant (HBM for params) and KV quant (HBM for cache) are
     independent axes — both on must still serve deterministically."""

@@ -8,14 +8,15 @@ the control plane shedding (QoS ladder per replica), and what did the
 last requests actually experience (recent journeys with attempts /
 TTFB / outcome). Everything comes from the operator surfaces the
 router and replicas already serve — `/debug/fleet`,
-`/debug/fleet/slo`, `/debug/journey`, and per-replica `/stats` +
-`/debug/qos` via the addresses the fleet snapshot advertises — so
+`/debug/fleet/slo`, `/debug/fleet/capacity`, `/debug/journey`, and
+per-replica `/stats` + `/debug/qos` via the addresses the fleet
+snapshot advertises — so
 grafttop needs no credentials, no agents, and nothing but stdlib.
 
 Usage:
     python tools/grafttop.py [--router http://127.0.0.1:9000]
                              [--interval 2] [--count 0] [--once]
-                             [--plain] [--no-color]
+                             [--plain] [--no-color] [--width N]
 
 --once renders a single frame and exits (testable / scriptable);
 --plain skips the ANSI clear-screen so frames append (pipes, logs).
@@ -27,6 +28,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import shutil
 import sys
 import time
 import urllib.request
@@ -49,6 +51,7 @@ def fetch(router: str) -> dict:
     out: dict = {"t": time.time()}
     for key, path in (("fleet", "/debug/fleet"),
                       ("fleet_slo", "/debug/fleet/slo"),
+                      ("capacity", "/debug/fleet/capacity"),
                       ("journeys", "/debug/journey"),
                       ("qos", "/debug/qos")):
         try:
@@ -98,9 +101,11 @@ def _state_mark(state: str, color: bool) -> str:
     return f"\x1b[{code}m{mark}\x1b[0m"
 
 
-def render(data: dict, color: bool = False) -> str:
+def render(data: dict, color: bool = False, width: int = 0) -> str:
     """One frame as a string (pure function of one fetch() result, so
-    tests can assert on it without a terminal)."""
+    tests can assert on it without a terminal). width > 0 truncates
+    each line to fit a narrow terminal; lines carrying ANSI sequences
+    are left whole so an escape is never cut mid-sequence."""
     lines: list = []
     stamp = time.strftime("%H:%M:%S", time.localtime(data.get("t", 0)))
     fleet = data.get("fleet") or {}
@@ -174,6 +179,41 @@ def render(data: dict, color: bool = False) -> str:
     if ladders:
         lines.append("  qos ladder " + "  ".join(ladders))
 
+    # -- capacity: fleet headroom + top tenants -----------------------------
+    lines.append("")
+    if "capacity_error" in data:
+        lines.append(f"  capacity: ERROR {data['capacity_error']}")
+    else:
+        cap = data.get("capacity") or {}
+        f = cap.get("fleet") or {}
+        lines.append(
+            f"  capacity rho [{_bar(f.get('rho'), scale=1.0)}] "
+            f"{_fmt(f.get('rho'))}  "
+            f"headroom={_fmt(f.get('headroom_tok_s'), 0)}tok/s  "
+            f"lambda={_fmt(f.get('lambda_tok_s'), 0)}tok/s  "
+            f"mu={_fmt(f.get('mu_tok_s'), 0)}tok/s  "
+            f"need={f.get('replicas_needed', '-')}"
+            f"/{f.get('replicas_total', '-')} replicas"
+            + ("  COLLAPSE" if f.get("collapse_warnings") else ""))
+        tenants = cap.get("tenants") or []
+        if tenants:
+            lines.append("  top tenants "
+                         + "  ".join(
+                             f"{t.get('tenant', '-')}="
+                             f"{_fmt(t.get('device_s'), 2, 's')}"
+                             for t in tenants[:5]))
+        reps = cap.get("replicas") or {}
+        marks = []
+        for name in sorted(reps):
+            snap = reps[name] or {}
+            if "error" in snap:
+                marks.append(f"{name}:ERR")
+                continue
+            marks.append(f"{name}:{_fmt(snap.get('rho'))}"
+                         + ("!" if snap.get("collapse_warning") else ""))
+        if marks:
+            lines.append("  replica rho " + "  ".join(marks))
+
     # -- recent journeys ----------------------------------------------------
     lines.append("")
     if "journeys_error" in data:
@@ -192,6 +232,8 @@ def render(data: dict, color: bool = False) -> str:
                 f"{_fmt(j.get('ttfb_s'), 3, 's'):8} "
                 f"{_fmt(j.get('stream_s'), 3, 's'):8} "
                 f"{str(j.get('chunks', '-')):6}")
+    if width and width > 0:
+        lines = [ln if "\x1b" in ln else ln[:width] for ln in lines]
     return "\n".join(lines)
 
 
@@ -207,15 +249,21 @@ def main() -> int:
     ap.add_argument("--plain", action="store_true",
                     help="no clear-screen between frames (pipes, logs)")
     ap.add_argument("--no-color", action="store_true")
+    ap.add_argument("--width", type=int, default=0,
+                    help="truncate lines to N columns; 0 = terminal "
+                         "width when on a tty, unlimited otherwise")
     args = ap.parse_args()
     count = 1 if args.once else args.count
     color = (not args.no_color) and sys.stdout.isatty()
     clear = "" if (args.plain or not sys.stdout.isatty()) else "\x1b[H\x1b[2J"
+    width = args.width
+    if not width and sys.stdout.isatty():
+        width = shutil.get_terminal_size().columns
 
     n = 0
     try:
         while True:
-            frame = render(fetch(args.router), color=color)
+            frame = render(fetch(args.router), color=color, width=width)
             sys.stdout.write(clear + frame + "\n")
             sys.stdout.flush()
             n += 1

@@ -20,13 +20,17 @@ depth, hand-offs, fallbacks), and — on QOS=true servers — the
 `/debug/qos` control-plane readout (shed-ladder level + transition
 trail, per-class queue/goodput/preemption counters, batch-lane depth),
 so soak artifacts gain efficiency, step-anatomy, error-budget, and
-QoS-control axes next to the tail evidence.
+QoS-control axes next to the tail evidence. CAPACITY=true servers add
+the `/debug/capacity` observatory line — per-tenant attribution totals
+plus the λ/μ/ρ headroom forecast (predicted TTFT, collapse warning).
 
 Router-tier targets additionally contribute the journey plane: the
 `/debug/fleet/slo` rollup (fleet burn windows, per-replica SLO states,
 hidden-page count) and a `/debug/journey` digest with nearest-rank
 p50/p90/p99 over the ring's router-observed TTFB and stream duration —
-cross-hop tail evidence next to the per-replica kind.
+cross-hop tail evidence next to the per-replica kind — and the
+`/debug/fleet/capacity` rollup (fleet ρ/headroom, top fleet-wide
+tenants, `replicas_needed`).
 
 Usage:
     python tools/obs_dump.py [--server http://127.0.0.1:8000]
@@ -235,6 +239,32 @@ def poll_once(server: str, metrics_base: str) -> dict:
         }
     except Exception as exc:  # noqa: BLE001 - journey plane off or absent
         entry["journeys_error"] = str(exc)
+    try:
+        body = json.loads(_get(server.rstrip("/") + "/debug/capacity"))
+        snap = body.get("data", body)
+        # attribution + forecast only — the accounts/steps evidence rings
+        # belong to the endpoint, not every JSONL line
+        entry["capacity"] = {
+            "totals": snap.get("totals"),
+            "tenants": snap.get("tenants", [])[:5],
+            "requests_total": snap.get("requests_total"),
+            "steps_total": snap.get("steps_total"),
+            "forecast": snap.get("forecast"),
+        }
+    except Exception as exc:  # noqa: BLE001 - CAPACITY=false servers lack it
+        entry["capacity_error"] = str(exc)
+    try:
+        body = json.loads(_get(server.rstrip("/") + "/debug/fleet/capacity"))
+        snap = body.get("data", body)
+        # the fleet rollup is already bounded: headline + top tenants +
+        # per-replica forecast rows ride along whole
+        entry["fleet_capacity"] = {
+            "fleet": snap.get("fleet"),
+            "tenants": snap.get("tenants", [])[:5],
+            "replicas": snap.get("replicas"),
+        }
+    except Exception as exc:  # noqa: BLE001 - only router-tier processes serve it
+        entry["fleet_capacity_error"] = str(exc)
     try:
         body = json.loads(_get(server.rstrip("/") + "/debug/qos"))
         snap = body.get("data", body)

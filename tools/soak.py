@@ -10,7 +10,8 @@ weak #4: "soak results are claims, not artifacts"):
     python tools/soak.py chat        # multi-turn sessions, tiered KV cache
     python tools/soak.py router      # fleet front door over 2 replicas
     python tools/soak.py multihost   # two-process live-traffic admission
-    python tools/soak.py all         # the seven in sequence
+    python tools/soak.py capacity    # attribution + headroom-forecast ramp
+    python tools/soak.py all         # every profile in sequence
     python tools/soak.py all --seconds 180 --threads 6
 
 Each profile boots an engine, runs N seconds of Poisson-arrival traffic
@@ -1054,6 +1055,11 @@ def run_qos(seconds: float, n_threads: int, preset: str) -> bool:
         "tenants": final["tenants"],
         "lane": lane.stats(),
     }
+    if getattr(engine, "meter", None) is not None:
+        msnap = engine.meter.snapshot()
+        stats["final"]["capacity"] = {
+            "totals": msnap["totals"], "tenants": msnap["tenants"][:5],
+            "forecast": msnap.get("forecast")}
     stats["published_jobs"] = published
     stats["lane_results"] = len(lane_results)
     complete = [r for r in lane_results
@@ -1094,12 +1100,317 @@ def run_qos(seconds: float, n_threads: int, preset: str) -> bool:
     return ok
 
 
+def run_capacity(seconds: float, n_threads: int, preset: str) -> bool:
+    """Capacity-observatory soak (tpu/meter.py): one CAPACITY=true
+    llm-server under a staged arrival ramp, validating the observatory's
+    three promises against live multi-tenant traffic —
+
+      * conservation: per-step attributed device-seconds equal the step
+        evidence ring's measured device segments (±5 % summed over the
+        ring), and tenant totals equal the sum of their requests'
+        accounts exactly;
+      * forecast tracking: the fluid-model predicted TTFT tracks the
+        measured TTFT p50 within the documented band (±50 % of p50,
+        60 ms floor — docs/capacity.md) on ramp stages below the knee
+        (ρ < 0.9);
+      * collapse early warning: a final open-loop overload stage grows
+        the queue at ρ near 1 and the warning must ARM — and if
+        measured TTFT ever blows past 4x the quiet baseline, the
+        warning must have fired first.
+
+    Pass = zero request errors, conservation ±5 %, tenant totals exact,
+    >= half the tracked ramp stages inside the band, and the overload
+    stage arming collapse (before the blowout when one occurs)."""
+    import importlib.util
+    import tempfile
+    import urllib.error
+    import urllib.request
+
+    from gofr_tpu.config import MockConfig
+
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
+                        "examples", "llm-server", "main.py")
+    spec = importlib.util.spec_from_file_location(
+        "soak_capacity_llm_server", path)
+    llm = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(llm)
+    small = preset == "debug"
+    app = llm.build_app(config=MockConfig({
+        "HTTP_PORT": "0", "METRICS_PORT": "0", "GRPC_PORT": "0",
+        "APP_NAME": "capacity-soak", "MODEL_PRESET": preset,
+        "PAGED": "true", "PAGE_SIZE": "16" if small else "128",
+        "MAX_SEQ_LEN": "256" if small else "1024",
+        "PREFILL_BUCKETS": "16,64" if small else "64,128,256",
+        "MAX_BATCH": "4" if small else "16", "WARMUP": "true",
+        "REQUEST_TIMEOUT": "300", "LOG_LEVEL": "ERROR",
+        # QoS supplies the header -> tenant/class plumbing; the ladder
+        # stays dark (the watched SLO is parked out of reach below) —
+        # this drill is about the observatory, not the shed ladder
+        "QOS": "true", "PUBSUB_BACKEND": "inproc", "QOS_EVAL_S": "0.5",
+        # short λ window so each stage's arrival rate reflects THAT
+        # stage, not the whole soak blurred together
+        "CAPACITY_WINDOW_S": "6", "CAPACITY_RHO_WARN": "0.8",
+        # the tenant-exact readout sums per-request accounts from the
+        # done ring — size it to hold every request this drill makes
+        "METER_REQUESTS": "4096",
+        "INCIDENT_DIR": os.path.join(
+            tempfile.mkdtemp(prefix="gofr-capacity-soak-"), "incidents"),
+    }))
+    app.start()
+    engine = app.engine
+    meter = engine.meter
+    fc = meter.forecaster
+    app.slo_burn.slo_ttft_s = 999.0          # ladder stays dark
+    base = f"http://127.0.0.1:{app.http_port}"
+    stats = {"profile": "capacity", "preset": preset,
+             "ok": 0, "shed": 0}
+    errors = []
+    lock = threading.Lock()
+    tenants = [f"tenant{i}" for i in range(4)]
+
+    def _ttft(cls: str, tenant: str, n_words: int, max_tokens: int,
+              timeout: float = 300.0):
+        """One streamed request; returns measured TTFT seconds."""
+        req = urllib.request.Request(
+            base + "/generate",
+            data=json.dumps({"prompt": " ".join(
+                                 f"{tenant}w{i}" for i in range(n_words)),
+                             "max_tokens": max_tokens,
+                             "stream": True}).encode(),
+            headers={"Content-Type": "application/json",
+                     "X-QoS-Class": cls, "X-Tenant": tenant},
+            method="POST")
+        t0 = time.time()
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                # first SSE line, not read(N): a block read waits for N
+                # bytes to accumulate, which on a short stream is most of
+                # the response — it would measure completion, not TTFT
+                first = None
+                while first is None:
+                    line = resp.readline()
+                    if not line:
+                        break
+                    if line.strip():
+                        first = time.time() - t0
+                while resp.read(4096):
+                    pass
+            with lock:
+                stats["ok"] += 1
+            return first
+        except urllib.error.HTTPError as err:
+            err.read()
+            with lock:
+                if err.code == 503:
+                    stats["shed"] += 1
+                else:
+                    errors.append(f"HTTP {err.code}")
+            return None
+        except Exception as exc:  # noqa: BLE001 - every failure is evidence
+            with lock:
+                errors.append(repr(exc)[:160])
+            return None
+
+    def _stage(idx: int, workers: int, sleep_s: float, duration: float,
+               max_tokens: int = 8) -> dict:
+        """Closed-loop workers measure TTFT while a sampler polls the
+        forecast; returns the stage's measured-vs-predicted row."""
+        ttfts: list = []
+        samples: list = []
+        stop_at = time.time() + duration
+
+        def worker(widx: int) -> None:
+            rng = random.Random(7000 + 100 * idx + widx)
+            while time.time() < stop_at:
+                t = _ttft("interactive" if widx % 2 else "standard",
+                          tenants[widx % len(tenants)],
+                          rng.choice([2, 4]), max_tokens)
+                if t is not None:
+                    with lock:
+                        ttfts.append((time.time(), t))
+                if sleep_s:
+                    time.sleep(sleep_s * (0.5 + rng.random()))
+        threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+                   for i in range(workers)]
+        for t in threads:
+            t.start()
+        while time.time() < stop_at:
+            samples.append((time.time(), fc.evaluate()))
+            time.sleep(0.25)
+        for t in threads:
+            t.join()
+
+        def pct(vals, q=0.5):
+            vals = sorted(vals)
+            return vals[int(q * (len(vals) - 1) + 0.5)] if vals else None
+        measured = [t for _, t in ttfts]
+        return {
+            "workers": workers, "n": len(measured),
+            "ttft_p50_ms": (round(pct(measured) * 1e3, 1)
+                            if measured else None),
+            "predicted_ttft_ms_p50": pct(
+                [s["predicted_ttft_ms"] for _, s in samples]),
+            "rho_p50": pct([s["rho"] for _, s in samples]),
+            "lambda_tok_s_p50": pct(
+                [s["lambda_tok_s"] for _, s in samples]),
+            "mu_tok_s_p50": pct(
+                [s["mu_tok_s"] for _, s in samples
+                 if s["mu_tok_s"] is not None]),
+            "_ttfts": ttfts, "_samples": samples,
+        }
+
+    t0 = time.time()
+    phase = max(6.0, seconds / 5.0)
+    engine.util.window_s = max(8.0, phase)
+    drained = False
+    try:
+        # ---- ramp: three stages of rising closed-loop load ---------------
+        ramp = [_stage(0, max(1, n_threads // 2), 0.5, phase),
+                _stage(1, n_threads, 0.2, phase),
+                _stage(2, 2 * n_threads, 0.05, phase)]
+        stats["ramp"] = [{k: v for k, v in row.items()
+                         if not k.startswith("_")} for row in ramp]
+
+        # ---- overload: a controlled walk past the knee -------------------
+        # a depth-targeting spawner grows the backlog LINEARLY (0 -> 60
+        # queued over the flood window) whatever this host's real service
+        # rate is, so the collapse detector sees its signal — sustained
+        # dq/dt > 0 at high rho — while measured TTFT is still degrading
+        # gradually, not after a step-function pile-up already blew it out
+        flood_len = max(phase, 12.0)
+        flood_t0 = time.time()
+        flood_stop = flood_t0 + flood_len
+        flooders: list = []
+        blowout: list = []
+        # "blowout" is SLO-scale degradation — an order of magnitude off
+        # the quiet baseline — not the first wobble past it; the early
+        # warning must beat THAT, which is what a pager cares about
+        baseline_ms = (ramp[0]["ttft_p50_ms"] or 50.0)
+        blowout_ms = max(8.0 * baseline_ms, 600.0)
+
+        def flooded(widx: int) -> None:
+            # light requests: service stays fast, so the backlog depth at
+            # which TTFT blows out sits well above the warning depth —
+            # the drill probes the detector, not this host's crawl speed
+            t = _ttft("interactive" if widx % 2 else "standard",
+                      tenants[widx % len(tenants)], 2, 8)
+            if t is not None:
+                with lock:
+                    if t * 1e3 > blowout_ms:
+                        blowout.append(time.time())
+        samples: list = []
+        spawned = 0
+        while time.time() < flood_stop and spawned < 400:
+            progress = (time.time() - flood_t0) / flood_len
+            # gentle early slope (p^1.5): the knee should be approached,
+            # not stepped past — that is the regime the early warning is
+            # for, and the one an autoscaler could still act in
+            target_depth = int(60 * progress ** 1.5)
+            deficit = target_depth - engine.queue_depth()
+            for _ in range(max(0, min(deficit, 25))):
+                th = threading.Thread(target=flooded, args=(spawned,),
+                                      daemon=True)
+                th.start()
+                flooders.append(th)
+                spawned += 1
+            samples.append((time.time(), fc.evaluate()))
+            time.sleep(0.25)
+        # keep sampling while the backlog drains — the warning may arm
+        # after the spawn cap if the queue is still climbing
+        while time.time() < flood_stop + 300.0 and engine.queue_depth():
+            samples.append((time.time(), fc.evaluate()))
+            time.sleep(0.5)
+        collapse_at = next((t for t, s in samples
+                            if s["collapse_warning"]), None)
+        # let the flood drain so shutdown is clean (and the meter folds
+        # every request before the conservation readout)
+        for th in flooders:
+            th.join(timeout=300.0)
+        stats["overload"] = {
+            "spawned": spawned,
+            "queue_depth_max": max(
+                (s["queue_depth"] for _, s in samples), default=0),
+            "rho_max": max((s["rho"] for _, s in samples), default=0.0),
+            "collapse_events": fc.collapse_events,
+            "collapse_at_s": (round(collapse_at - t0, 2)
+                              if collapse_at else None),
+            "first_blowout_at_s": (round(min(blowout) - t0, 2)
+                                   if blowout else None),
+            "blowout_ms": round(blowout_ms, 1),
+        }
+        drained = engine.drain(timeout_s=120)
+    finally:
+        app.shutdown()
+    stats["seconds"] = round(time.time() - t0, 1)
+    stats["drained"] = drained
+
+    # ---- the observatory's evidence -------------------------------------
+    snap = meter.snapshot()
+    steps = snap["steps"]
+    ring = list(meter._steps)
+    total_attr = sum(s["attributed_s"] for s in ring)
+    total_meas = sum(s["device_s"] for s in ring)
+    conserve_err = (abs(total_attr - total_meas) / total_meas
+                    if total_meas else 1.0)
+    tenant_exact = True
+    with meter._lock:
+        per: dict = {}
+        for acct in list(meter._done) + list(meter._live.values()):
+            key = (acct.tenant, acct.cls)
+            per[key] = per.get(key, 0.0) + acct.device_s
+        for key, tacct in meter._accounts.items():
+            if abs(tacct.device_s - per.get(key, 0.0)) > 1e-6:
+                tenant_exact = False
+    stats["attribution"] = {
+        "totals": snap["totals"],
+        "tenants": snap["tenants"],
+        "requests_total": snap["requests_total"],
+        "steps_total": snap["steps_total"],
+        "ring_attributed_s": round(total_attr, 6),
+        "ring_device_s": round(total_meas, 6),
+        "conservation_err": round(conserve_err, 5),
+        "tenant_totals_exact": tenant_exact,
+        "steps_sample": steps[-3:],
+    }
+
+    # forecast band: documented ±50 % of p50 (60 ms floor) below the knee
+    tracked = [r for r in stats["ramp"]
+               if (r["rho_p50"] or 1.0) < 0.9 and r["n"] >= 5
+               and r["ttft_p50_ms"] and r["predicted_ttft_ms_p50"]
+               is not None]
+    in_band = [r for r in tracked
+               if abs(r["predicted_ttft_ms_p50"] - r["ttft_p50_ms"])
+               <= max(0.5 * r["ttft_p50_ms"], 60.0)]
+    stats["forecast_tracking"] = {
+        "stages_tracked": len(tracked), "stages_in_band": len(in_band),
+        "errors_ms": [round(r["predicted_ttft_ms_p50"]
+                            - r["ttft_p50_ms"], 1) for r in tracked],
+    }
+    over = stats["overload"]
+    collapse_ok = over["collapse_events"] >= 1 and (
+        over["first_blowout_at_s"] is None
+        or (over["collapse_at_s"] is not None
+            and over["collapse_at_s"] <= over["first_blowout_at_s"]))
+    if errors:
+        stats["error_samples"] = errors[:8]
+    ok = (not errors
+          and stats["ok"] > 0
+          and conserve_err <= 0.05
+          and tenant_exact
+          and (not tracked or len(in_band) * 2 >= len(tracked))
+          and collapse_ok
+          and drained)
+    stats["pass"] = ok
+    print(json.dumps(stats))
+    return ok
+
+
 def main() -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("profile", nargs="?", default="all",
                         choices=["mixed", "paged-int8", "spec", "chat",
                                  "disagg", "router", "multihost", "qos",
-                                 "all"])
+                                 "capacity", "all"])
     parser.add_argument("--seconds", type=float, default=120.0)
     parser.add_argument("--threads", type=int, default=4)
     parser.add_argument("--chaos", action="store_true",
@@ -1116,7 +1427,7 @@ def main() -> int:
     preset = os.environ.get("SOAK_PRESET", "debug")
 
     profiles = (["mixed", "paged-int8", "spec", "chat", "disagg", "router",
-                 "qos", "multihost"]
+                 "qos", "capacity", "multihost"]
                 if args.profile == "all" else [args.profile])
     results = []
     for p in profiles:
@@ -1126,6 +1437,8 @@ def main() -> int:
             results.append(run_router(args.seconds, args.threads, preset))
         elif p == "qos":
             results.append(run_qos(args.seconds, args.threads, preset))
+        elif p == "capacity":
+            results.append(run_capacity(args.seconds, args.threads, preset))
         elif p == "multihost":
             # under `all`, cap the two-process tier so it doesn't dominate
             # the sequence's wall time (the plane's invariants saturate
