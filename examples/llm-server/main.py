@@ -13,6 +13,7 @@ identical serving/throughput/latency behavior.
 
 import os
 import sys
+import threading
 import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
@@ -279,7 +280,45 @@ def build_engine(app: App, default_sampling_controls: bool = False) -> LLMEngine
     # admission width per bucket, so organic staggered traffic never pays
     # a first-use compile mid-request (amortized by PROGRAM_CACHE_DIR)
     warm_mode = app.config.get_or_default("WARMUP", "true").lower()
-    if warm_mode not in ("false", "0", "no", "off"):
+    # ELASTIC_WARM_BOOT=true makes warmup ASYNC behind a `warming`
+    # lifecycle advertisement: the HTTP surface comes up immediately, the
+    # fleet router holds traffic until /stats says serving, and warmup
+    # rides the shared PROGRAM_CACHE_DIR (cache hits, not fresh XLA
+    # compiles) plus a KV pre-warm pulled from ELASTIC_PREWARM_PEERS'
+    # /debug/kvtier inventories — the seconds-not-minutes boot an
+    # autoscaler launch needs
+    from gofr_tpu.tpu.migrate import Lifecycle
+
+    warm_boot = app.config.get_bool("ELASTIC_WARM_BOOT", False)
+    engine.lifecycle = Lifecycle("warming" if warm_boot else "serving")
+    if warm_boot:
+        peers = [p.strip() for p in app.config.get_or_default(
+            "ELASTIC_PREWARM_PEERS", "").split(",") if p.strip()]
+        prewarm_pages = app.config.get_int("ELASTIC_PREWARM_PAGES", 64)
+
+        def _warm_boot():
+            from gofr_tpu.tpu.migrate import prewarm_from_peers
+
+            t0 = time.time()
+            warmed = 0
+            try:
+                if warm_mode not in ("false", "0", "no", "off"):
+                    engine.warmup(k_variants=warm_mode == "wide")
+                if peers:
+                    warmed = prewarm_from_peers(engine, peers,
+                                                limit=prewarm_pages,
+                                                logger=app.logger)
+            except Exception as exc:  # noqa: BLE001 - serve cold > never
+                app.logger.errorf("warm boot: %s", exc)
+            engine.lifecycle.to("serving")
+            engine.warm_boot_s = round(time.time() - t0, 3)
+            app.logger.infof("warm boot: serving after %.1fs "
+                             "(%d pages pre-warmed)",
+                             engine.warm_boot_s, warmed)
+
+        threading.Thread(target=_warm_boot, name="warm-boot",
+                         daemon=True).start()
+    elif warm_mode not in ("false", "0", "no", "off"):
         t0 = time.time()
         engine.warmup(k_variants=warm_mode == "wide")
         app.logger.infof("engine warmed up in %.1fs%s", time.time() - t0,
@@ -491,8 +530,22 @@ def build_app(config=None, engine=None) -> App:
         block=app.config.get_int("FLEET_AFFINITY_BLOCK", 256))
     app.fleet_affinity = affinity
 
+    # elastic lifecycle + drain-with-migration: every replica advertises
+    # warming/serving/draining through /stats (routers gate on it) and
+    # serves POST /debug/drain — scale-down migrates still-live sessions
+    # to peers over POST /migrate instead of holding the replica for
+    # their full generation (DRAIN_MIGRATE=false keeps the surface off)
+    app.enable_drain_migration(engine)
+    lifecycle = engine.lifecycle
+
     @app.post("/generate")
     def generate(ctx):
+        if lifecycle.state == "draining":
+            # new sessions belong on a peer; in-flight streams (and
+            # migrations landing on /migrate's submit_handoff path,
+            # which outranks admission) are unaffected
+            raise ServiceUnavailable("replica is draining",
+                                     retry_after_s=1.0)
         body = ctx.bind()
         prompt = body.get("prompt")
         if not isinstance(prompt, str) or not prompt:
@@ -598,7 +651,16 @@ def build_app(config=None, engine=None) -> App:
             out["slo"] = recorder.slo_stats()
         # cheap fleet probe payload: O(k) affinity digest + duty cycle,
         # NOT the full /debug/engine page-pool dump
-        fleet = {"affinity": affinity.digest()}
+        fleet = {"affinity": affinity.digest(),
+                 "lifecycle": lifecycle.state}
+        warm_boot_s = getattr(engine, "warm_boot_s", None)
+        if warm_boot_s is not None:
+            fleet["warm_boot_s"] = warm_boot_s
+        qos_ctl = getattr(engine, "qos", None)
+        if qos_ctl is not None:
+            # the shed ladder's request_replica rung, fleet-visible: the
+            # autoscaler treats it as "add capacity before I shed"
+            fleet["qos"] = {"scaleout_wanted": qos_ctl.scaleout_wanted}
         util = getattr(engine, "util", None)
         if util is not None:
             fleet["duty_cycle"] = util.window_stats()["duty_cycle"]

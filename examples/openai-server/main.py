@@ -113,6 +113,28 @@ def build_app(**kw) -> App:
     tokenizer = engine.tokenizer
     model_id = app.config.get_or_default("MODEL_PRESET", "debug")
 
+    # elastic lifecycle + drain-with-migration surface (llm-server
+    # parity): advertise warming/serving/draining via /stats below, land
+    # peer migrations on POST /migrate, drain via POST /debug/drain
+    app.enable_drain_migration(engine)
+    lifecycle = engine.lifecycle
+
+    @app.get("/stats")
+    def stats(ctx):  # noqa: ARG001 - fleet probe payload (llm-server parity)
+        fleet = {"lifecycle": lifecycle.state}
+        qos_ctl = getattr(engine, "qos", None)
+        if qos_ctl is not None:
+            fleet["qos"] = {"scaleout_wanted": qos_ctl.scaleout_wanted}
+        util = getattr(engine, "util", None)
+        if util is not None:
+            fleet["duty_cycle"] = util.window_stats()["duty_cycle"]
+        return {
+            "active_slots": sum(1 for s in engine.slots if s.active),
+            "queue_depth": engine._pending.qsize(),
+            "stall_seconds": round(engine.stall_seconds, 1),
+            "fleet": fleet,
+        }
+
     # parameters this surface cannot honor are REJECTED (400), never
     # silently ignored — a client that sent frequency_penalty=0.8 must not
     # get un-penalized text labeled as if its request was honored. The
@@ -193,6 +215,12 @@ def build_app(**kw) -> App:
                      if ctx is not None else None)
         tenant = (str(ctx.request.header("X-Tenant") or "")
                   if ctx is not None else "")
+        if lifecycle.state == "draining":
+            from gofr_tpu.http.errors import ServiceUnavailable
+
+            # new sessions belong on a peer (llm-server parity)
+            raise ServiceUnavailable("replica is draining",
+                                     retry_after_s=1.0)
         try:
             return submitter.submit(
                 prompt_tokens, max_new_tokens=max_tokens,

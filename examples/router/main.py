@@ -25,18 +25,23 @@ holds the handler until the replica finishes generating.
 """
 
 import os
+import sys
 
-from gofr_tpu import App
-from gofr_tpu.fleet import (FleetCapacity, FleetRouter, FleetSLO,
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from gofr_tpu import App  # noqa: E402
+from gofr_tpu.fleet import (FleetCapacity, FleetRouter, FleetSLO,  # noqa: E402
                             JourneyRecorder, install_routes,
                             register_fleet_capacity_metrics,
                             register_fleet_metrics,
                             register_fleet_slo_metrics,
                             register_journey_metrics)
 from gofr_tpu.fleet.capacity import \
-    install_routes as install_fleet_capacity_routes
-from gofr_tpu.fleet.journey import install_routes as install_journey_routes
-from gofr_tpu.fleet.slo import install_routes as install_fleet_slo_routes
+    install_routes as install_fleet_capacity_routes  # noqa: E402
+from gofr_tpu.fleet.journey import \
+    install_routes as install_journey_routes  # noqa: E402
+from gofr_tpu.fleet.slo import \
+    install_routes as install_fleet_slo_routes  # noqa: E402
 
 
 def build_app(config=None) -> App:
@@ -110,6 +115,27 @@ def build_app(config=None) -> App:
         # rho/replicas_needed must track probe reality while idle
         app.container.add_scrape_hook("fleet_capacity",
                                       router.capacity.publish)
+    # elastic control plane: the autoscaler reconciler actuates what the
+    # capacity rollup recommends (launch on sustained demand, drain with
+    # live-session migration on sustained calm) and serves the operator
+    # drain at POST /debug/fleet/drain/{replica}.  ELASTIC=false opts
+    # out; with ELASTIC_LAUNCHER=none (default) the reconciler observes
+    # and drains but never launches — tests/soak inject an
+    # InProcessLauncher onto app.autoscaler.launcher
+    app.autoscaler = None
+    if app.config.get_bool("ELASTIC", True):
+        from gofr_tpu.fleet import (FleetAutoscaler, install_elastic_routes,
+                                    register_elastic_metrics)
+
+        if metrics is not None:
+            register_elastic_metrics(metrics)
+        autoscaler = FleetAutoscaler.from_config(
+            app.config, router, capacity=router.capacity,
+            metrics=metrics, logger=app.logger)
+        app.autoscaler = autoscaler
+        install_elastic_routes(app, autoscaler)
+        autoscaler.start()
+        app.on_shutdown(autoscaler.stop)
     router.start()
     app.on_shutdown(router.stop)
     return app

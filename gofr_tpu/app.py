@@ -613,6 +613,42 @@ class App:
         install_routes(self, meter, path)
         return meter
 
+    def enable_drain_migration(self, engine):
+        """Wire the elastic replica surface (tpu/migrate.py) onto an
+        engine: the warming/serving/draining Lifecycle (advertised by the
+        server's /stats for fleet routers to gate on), the
+        MigrationCoordinator behind POST /debug/drain (drain-with-
+        migration: live sessions export as KV hand-off envelopes and
+        continue on a peer, replay-ladder fallback on any failure), the
+        peer-side POST /migrate landing endpoint, and the
+        GET /debug/kvtier inventory that warm-booting peers pre-warm
+        from.  Gated on DRAIN_MIGRATE (default true); the lifecycle is
+        attached either way so /stats always has a truthful state.
+
+        Config: DRAIN_MIGRATE (surface on/off), DRAIN_SHIP_TIMEOUT_S
+        (per-session ship/relay budget, 60).  Returns the
+        MigrationCoordinator (None when gated off)."""
+        from .tpu.migrate import (Lifecycle, MigrationCoordinator,
+                                  install_migration_routes,
+                                  register_migration_metrics)
+
+        lifecycle = getattr(engine, "lifecycle", None)
+        if lifecycle is None:
+            lifecycle = Lifecycle("serving")
+            engine.lifecycle = lifecycle
+        if not self.config.get_bool("DRAIN_MIGRATE", True):
+            return None
+        metrics = self.container.metrics_manager
+        if metrics is not None:
+            register_migration_metrics(metrics)
+        coordinator = MigrationCoordinator(
+            engine, lifecycle, metrics=metrics, logger=self.logger,
+            ship_timeout_s=self.config.get_float("DRAIN_SHIP_TIMEOUT_S",
+                                                 60.0))
+        self.drain_coordinator = coordinator
+        install_migration_routes(self, engine, coordinator)
+        return coordinator
+
     # -- cross-cutting registrations ------------------------------------------
     def add_http_service(self, name: str, address: str, *options) -> None:
         from .service import new_http_service

@@ -31,6 +31,10 @@ the failure matrix.
 from .affinity import AffinityMap, AffinityRecorder, affinity_keys
 from .capacity import FleetCapacity, register_fleet_capacity_metrics
 from .debug import register_fleet_metrics
+from .elastic import (FleetAutoscaler, InProcessLauncher, ReplicaLauncher,
+                      SubprocessLauncher, launcher_from_config,
+                      register_elastic_metrics)
+from .elastic import install_routes as install_elastic_routes
 from .journey import JourneyRecorder, register_journey_metrics
 from .policy import (AffinityPolicy, P2CPolicy, RoundRobinPolicy,
                      RoutingPolicy, make_policy)
@@ -46,4 +50,7 @@ __all__ = [
     "JourneyRecorder", "register_journey_metrics",
     "FleetBurnEngine", "FleetSLO", "register_fleet_slo_metrics",
     "FleetCapacity", "register_fleet_capacity_metrics",
+    "FleetAutoscaler", "ReplicaLauncher", "InProcessLauncher",
+    "SubprocessLauncher", "launcher_from_config",
+    "register_elastic_metrics", "install_elastic_routes",
 ]

@@ -54,6 +54,12 @@ class FleetRouter:
         self.affinity_misses = 0
         self.stream_breaks = 0
         self.no_replica = 0
+        # lifecycle exclusions observed per forward: replicas the elastic
+        # plane held out of the candidate set (warming boot, draining
+        # exit).  Deliberately NOT routed through _count_route — a
+        # held-out replica is not an affinity miss, and it never feeds
+        # the breaker
+        self.route_skips = {}
         # per-QoS-class accounting ("unclassified" for legacy traffic):
         # routed = committed to a replica, shed = 503 replies consumed
         # by the retry loop — the fleet-level view of replica shedding
@@ -128,6 +134,20 @@ class FleetRouter:
                     self.metrics.increment_counter(
                         "app_tpu_fleet_affinity_misses_total")
 
+    def _count_route_skips(self):
+        """Once per forward: record replicas excluded by lifecycle, under
+        the same route_total metric so dashboards see WHY the candidate
+        set shrank (reason=warming|draining)."""
+        for replica in list(self.registry.replicas):
+            lifecycle = replica.effective_lifecycle
+            if lifecycle == "serving":
+                continue
+            self.route_skips[lifecycle] = self.route_skips.get(lifecycle, 0) + 1
+            if self.metrics is not None:
+                self.metrics.increment_counter("app_tpu_fleet_route_total",
+                                               policy=self.policy.name,
+                                               reason=lifecycle)
+
     def _count_retry(self, reason):
         self.retries[reason] = self.retries.get(reason, 0) + 1
         if self.metrics is not None:
@@ -169,6 +189,7 @@ class FleetRouter:
         tried = set()
         attempts = 1 + self.retry_budget
         shortest_shed = None
+        self._count_route_skips()
         for attempt in range(attempts):
             candidates = self.registry.candidates(exclude=tried)
             if not candidates:
@@ -187,6 +208,12 @@ class FleetRouter:
                 tried.add(replica.name)
                 kind = ("breaker_open" if isinstance(exc, CircuitOpenError)
                         else "connect_error")
+                if replica.effective_lifecycle == "draining":
+                    # the replica went draining between candidate
+                    # selection and connect — still UNSTARTED, still
+                    # retryable, but labeled so drains don't read as
+                    # transport faults
+                    kind = "draining"
                 self._count_retry(kind)
                 if journeys is not None:
                     journeys.attempt_outcome(journey, kind)
@@ -195,6 +222,17 @@ class FleetRouter:
                                       kind, replica.name, attempt + 1, exc)
                 continue
             if resp.status_code == 503:
+                if replica.effective_lifecycle == "draining":
+                    # mid-drain refusal: the replica is LEAVING, not
+                    # overloaded — retry elsewhere without charging the
+                    # shed window (note_shed would outlive the replica)
+                    resp.close()
+                    replica.end()
+                    tried.add(replica.name)
+                    self._count_retry("draining")
+                    if journeys is not None:
+                        journeys.attempt_outcome(journey, "draining")
+                    continue
                 retry_after = _parse_retry_after(resp.header("Retry-After"))
                 replica.note_shed(retry_after)
                 shortest_shed = (retry_after if shortest_shed is None
@@ -310,6 +348,7 @@ class FleetRouter:
             "retry_budget": self.retry_budget,
             "routes": dict(self.routes),
             "routes_total": total_routes,
+            "route_skips": dict(self.route_skips),
             "retries": dict(self.retries),
             "classes": {"routes": dict(self.class_routes),
                         "sheds": dict(self.class_sheds)},

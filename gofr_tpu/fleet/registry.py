@@ -66,6 +66,16 @@ class Replica:
         self.stream_breaks = 0
         self._inflight = 0
         self._lock = threading.Lock()
+        # elastic lifecycle (fleet/elastic.py): what the replica itself
+        # advertises via /stats fleet.lifecycle, plus a router-side
+        # override pinned while THIS router launches (warming) or drains
+        # (draining) it.  The override outranks the advertisement — a
+        # freshly launched replica must not take traffic on the strength
+        # of a probe that raced its boot, and a drain the router ordered
+        # holds even if the replica's advertisement lags a probe cycle.
+        self.lifecycle = "serving"
+        self.lifecycle_override = None
+        self.scaleout_wanted = False
 
     # -- in-flight accounting -------------------------------------------------
     def begin(self):
@@ -98,9 +108,14 @@ class Replica:
     def breaker_open(self):
         return self.client.open
 
+    @property
+    def effective_lifecycle(self):
+        return self.lifecycle_override or self.lifecycle
+
     def available(self, now=None):
         return (self.state != STATUS_DOWN and not self.breaker_open
-                and not self.shedding(now))
+                and not self.shedding(now)
+                and self.effective_lifecycle == "serving")
 
     def snapshot(self):
         return {
@@ -118,6 +133,8 @@ class Replica:
             "inflight": self.inflight,
             "load": self.load(),
             "stream_breaks": self.stream_breaks,
+            "lifecycle": self.effective_lifecycle,
+            "scaleout_wanted": self.scaleout_wanted,
             "generation": self.generation,
             "probe_age_s": (round(time.monotonic() - self.last_probe_at, 3)
                             if self.last_probe_at else None),
@@ -137,6 +154,13 @@ class FleetRegistry:
         self.logger = logger
         self._stop = threading.Event()
         self._thread = None
+        # construction defaults for replicas added at runtime
+        # (FleetAutoscaler scale-up); from_config overrides with its
+        # FLEET_* values so launched replicas match the seeded ones
+        self.replica_timeout_s = DEFAULT_TIMEOUT_S
+        self.breaker_threshold = DEFAULT_BREAKER_THRESHOLD
+        self.breaker_interval_s = DEFAULT_BREAKER_INTERVAL_S
+        self._members_lock = threading.Lock()
 
     @classmethod
     def from_config(cls, config, logger=None, metrics=None, affinity_map=None):
@@ -164,8 +188,12 @@ class FleetRegistry:
                                     breaker_threshold=threshold,
                                     breaker_interval_s=interval_s))
         probe_s = config.get_float("FLEET_PROBE_S", DEFAULT_PROBE_S)
-        return cls(replicas, affinity_map=affinity_map, probe_s=probe_s,
-                   metrics=metrics, logger=logger)
+        registry = cls(replicas, affinity_map=affinity_map, probe_s=probe_s,
+                       metrics=metrics, logger=logger)
+        registry.replica_timeout_s = timeout_s
+        registry.breaker_threshold = threshold
+        registry.breaker_interval_s = interval_s
+        return registry
 
     def replica(self, name):
         for r in self.replicas:
@@ -177,6 +205,54 @@ class FleetRegistry:
         now = time.monotonic()
         return [r for r in self.replicas
                 if r.available(now) and r.name not in exclude]
+
+    # -- elastic membership ---------------------------------------------------
+    def add_replica(self, name, address, lifecycle_override="warming"):
+        """Register a freshly launched replica (autoscaler scale-up).
+        It joins under a ``warming`` override — a brand-new Replica's
+        UNKNOWN state would otherwise pass ``available()`` before the
+        first probe, routing traffic at a cold, still-compiling engine.
+        The override clears when the replica's own advertisement says
+        serving.  Idempotent on name."""
+        existing = self.replica(name)
+        if existing is not None:
+            return existing
+        replica = Replica(name, address, logger=self.logger,
+                          metrics=self.metrics,
+                          timeout_s=self.replica_timeout_s,
+                          breaker_threshold=self.breaker_threshold,
+                          breaker_interval_s=self.breaker_interval_s)
+        replica.lifecycle_override = lifecycle_override
+        with self._members_lock:
+            self.replicas = self.replicas + [replica]
+        if self.logger is not None:
+            self.logger.infof("fleet: replica %s joined (%s) at %s",
+                              name, lifecycle_override or "serving", address)
+        return replica
+
+    def remove_replica(self, name):
+        """Forget a replica entirely (post-drain scale-down)."""
+        with self._members_lock:
+            kept = [r for r in self.replicas if r.name != name]
+            removed = len(kept) != len(self.replicas)
+            self.replicas = kept
+        if removed:
+            self.affinity_map.forget(name)
+            if self.logger is not None:
+                self.logger.infof("fleet: replica %s removed", name)
+        return removed
+
+    def announce_drain(self, name):
+        """Mark a replica draining ON THE ANNOUNCEMENT: new sessions stop
+        routing to it and its learned affinity entries drop NOW — waiting
+        for the eventual DOWN would keep steering sticky sessions into a
+        replica that refuses them.  Returns dropped affinity count, or
+        None for an unknown replica."""
+        replica = self.replica(name)
+        if replica is None:
+            return None
+        replica.lifecycle_override = "draining"
+        return self.affinity_map.forget(name)
 
     # -- probing --------------------------------------------------------------
     def start(self):
@@ -202,7 +278,7 @@ class FleetRegistry:
                     self.logger.errorf("fleet probe loop: %s", exc)
 
     def probe_once(self):
-        for replica in self.replicas:
+        for replica in list(self.replicas):  # membership can change mid-walk
             self._probe(replica)
         self._publish_gauges()
 
@@ -243,6 +319,24 @@ class FleetRegistry:
             replica.active_slots = int(stats.get("active_slots", 0) or 0)
             fleet = stats.get("fleet") or {}
             replica.duty_cycle = float(fleet.get("duty_cycle", 0.0) or 0.0)
+            was_draining = replica.effective_lifecycle == "draining"
+            advertised = str(fleet.get("lifecycle") or "serving")
+            if advertised in ("warming", "serving", "draining"):
+                replica.lifecycle = advertised
+            if (advertised == "serving"
+                    and replica.lifecycle_override == "warming"):
+                # boot confirmed by the replica itself; release traffic
+                replica.lifecycle_override = None
+            qos = fleet.get("qos") or {}
+            replica.scaleout_wanted = bool(qos.get("scaleout_wanted"))
+            if replica.effective_lifecycle == "draining" and not was_draining:
+                # replica announced its own drain (operator hit it
+                # directly): drop learned affinity on the announcement
+                dropped = self.affinity_map.forget(replica.name)
+                if self.logger is not None and dropped:
+                    self.logger.infof(
+                        "fleet: replica %s draining; dropped %d affinity entries",
+                        replica.name, dropped)
             digest = fleet.get("affinity") or {}
             generation = digest.get("generation")
             if generation is not None:
@@ -253,6 +347,11 @@ class FleetRegistry:
                         self.logger.infof(
                             "fleet: replica %s restarted; dropped %d affinity entries",
                             replica.name, dropped)
+                    # a restart is a fresh boot: stale router-side drain or
+                    # warming pins no longer describe this process
+                    replica.lifecycle_override = None
+                    replica.lifecycle = advertised if advertised in (
+                        "warming", "serving", "draining") else "serving"
                 replica.generation = generation
             keys = digest.get("keys") or []
             if keys:
@@ -270,7 +369,7 @@ class FleetRegistry:
             return
         now = time.monotonic()
         available = 0
-        for r in self.replicas:
+        for r in list(self.replicas):
             value = _STATE_GAUGE.get(r.state, 0)
             if r.breaker_open:
                 value = 0

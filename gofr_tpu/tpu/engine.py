@@ -420,6 +420,12 @@ class LLMEngine:
     # growth/ping-pong transient (its pool is fixed and never carried whole)
     _plan_paged = False
 
+    # KV hand-off landing: the paged subclass flips this True — its _admit
+    # can restore shipped page blobs (kvtier.PageBlob) into the pool. Used
+    # by disaggregated decode pools AND by elastic drain-with-migration
+    # (fleet/elastic.py); the dense engine always replays from tokens.
+    supports_kv_handoff = False
+
     # adaptive-speculation tuning (class attrs so tests can tighten them):
     # EMA smoothing of accepted-per-slot, the floor below which verify
     # dispatches pause, and how many block-decode dispatches a cooloff lasts
@@ -780,6 +786,16 @@ class LLMEngine:
         # metrics is None
         self.handoffs_total = 0
         self.handoff_fallbacks_total = 0
+
+        # elastic drain-with-migration (fleet/elastic.py): a coordinator
+        # requests a one-shot export of every live decode slot. The loop
+        # picks it up at a quiesced boundary (no in-flight dispatches),
+        # offers each session to the sink as (request, blobs, n_ctx), and
+        # evacuates slots the sink took. Sessions the sink refuses keep
+        # decoding locally — migration can only improve on the status quo.
+        self._migrate_sink = None
+        self._migrate_request = False
+        self.migrations_total = 0
 
         # in-flight dispatches awaiting host sync, processed FIFO:
         #   ("decode", out_tokens [B, M] future, [(slot_idx, request)], M)
@@ -1155,8 +1171,9 @@ class LLMEngine:
             raise DeviceLostError(retry_after)
         if not prompt_tokens:
             raise ValueError("prompt_tokens must be non-empty")
-        if blobs is not None and self.disagg_role != "decode":
-            raise ValueError("KV blobs require disagg_role='decode'")
+        if blobs is not None and not self._lands_handoffs:
+            raise ValueError("KV blobs require a paged engine outside the "
+                             "prefill role")
         if (top_p or top_k) and not self.sampling_controls:
             raise ValueError("per-request top_p/top_k need an engine built "
                              "with sampling_controls=True")
@@ -1321,6 +1338,105 @@ class LLMEngine:
                 return True
             time.sleep(0.05)
         return False
+
+    @property
+    def _lands_handoffs(self) -> bool:
+        """True when this engine can restore shipped KV page blobs at
+        admission: the paged pool outside the prefill disagg role. Decode
+        pools land disagg hand-offs; ANY colocated paged replica lands
+        elastic migration exports."""
+        return self.supports_kv_handoff and self.disagg_role != "prefill"
+
+    def request_migration(self, sink) -> None:
+        """Ask the loop to export every live decode session to ``sink``
+        (elastic drain-with-migration, fleet/elastic.py). Thread-safe;
+        returns immediately. The loop waits for in-flight dispatches to
+        sync (pipeline_depth steps at most), then calls
+        ``sink(request, blobs, n_ctx)`` once per active slot at the
+        quiesced boundary: True means the sink took ownership of the
+        stream (the slot evacuates, nothing further is emitted locally);
+        False/raise leaves the slot bound and decoding locally. One-shot:
+        slots admitted after the export round are NOT offered — callers
+        drain admission first (registry ``draining`` state + engine
+        drain()) so nothing new lands mid-migration."""
+        if self._plane is not None:
+            raise RuntimeError("migration is single-controller only; the "
+                               "multi-host admission plane cannot mirror "
+                               "slot evacuations")
+        self._migrate_sink = sink
+        self._migrate_request = True
+        self._wake.set()
+
+    @property
+    def migration_pending(self) -> bool:
+        """True while a requested export round has not yet run — the
+        drain coordinator polls this to know the sink is settled."""
+        return self._migrate_request
+
+    @loop_only
+    def _migrate_active_slots(self) -> None:
+        """One migration round at a quiesced step boundary (loop thread,
+        under _state_lock, nothing in flight). Export order is slot order;
+        each session the sink takes is evacuated with the preemption
+        primitive — the request object (and its client stream) lives on,
+        owned by the sink.
+
+        _migrate_request clears at the END of the round (the D2H pulls
+        take real time): migration_pending is the coordinator's signal
+        that every sink call has happened, so clearing it on entry would
+        let the poller read a half-built export list."""
+        sink, self._migrate_sink = self._migrate_sink, None
+        if sink is None:
+            self._migrate_request = False
+            return
+        try:
+            for slot in self.slots:
+                if not slot.active or slot.chunking is not None:
+                    continue
+                request = slot.request
+                if self._is_cancelled(request):
+                    continue  # normal cancel teardown handles it
+                if request.max_new_tokens - request.generated <= 0:
+                    continue  # finishing this step; migrating buys nothing
+                blobs, n_ctx = self._export_slot_kv(slot, request)
+                try:
+                    took = bool(sink(request, blobs, n_ctx))
+                except Exception as exc:  # noqa: BLE001 - a broken sink must not kill serving
+                    if self.logger is not None:
+                        self.logger.errorf("migration sink failed for %s: %s",
+                                           request.id, exc)
+                    took = False
+                if not took:
+                    continue  # slot stays bound: local decode is the floor
+                self._release_slot_for_preempt(slot)
+                request.finished_at = time.monotonic()
+                self.migrations_total += 1
+                self._obs.counter("app_tpu_elastic_migrations_total",
+                                  phase="export")
+                if request.gen_span is not None:
+                    request.gen_span.set_attribute("elastic.migrated", True)
+                    request.gen_span.set_attribute(
+                        "elastic.pages", len(blobs) if blobs else 0)
+                    request.gen_span.end()
+                    request.gen_span = None
+                if self.recorder is not None:
+                    self.recorder.record_event(
+                        request.id, "migrated",
+                        pages=len(blobs) if blobs else 0,
+                        emitted=len(request.emitted))
+                    self.recorder.record_finished(request, "migrated")
+        finally:
+            self._migrate_request = False
+        self._obs.gauge("app_tpu_active_slots",
+                        sum(1 for s in self.slots if s.active))
+
+    def _export_slot_kv(self, slot, request):
+        """(blobs, n_ctx) for a migration export. The dense engine ships
+        nothing — blobs=None means the peer replays prompt+emitted (the
+        crash-only recompute contract), which is always correct, just not
+        prefill-free. The paged engine overrides this with the D2H page
+        pull (paging._handoff_slot's recipe)."""
+        return None, max(0, len(request.resume_tokens) - 1)
 
     def warmup(self, grow: bool = True, k_variants: bool = False) -> None:
         """Pre-compile single-admission prefill buckets and the decode
@@ -2024,7 +2140,18 @@ class LLMEngine:
                     # the next iteration's admissions interleave with a
                     # long prompt's remaining chunks
                     self._advance_chunk_job()
+                    if self._migrate_request and not self._inflight \
+                            and not self._chunk_jobs:
+                        # quiesced: every dispatch synced, so slot.length
+                        # and resume_tokens agree — export is exact
+                        with steps.seg("kv_handoff"):
+                            self._migrate_active_slots()
                     any_active = any(slot.active for slot in self.slots)
+                    if any_active and self._migrate_request:
+                        # a migration round is pending: stop feeding the
+                        # pipeline so in-flight work drains to the
+                        # quiesced boundary within pipeline_depth syncs
+                        any_active = False
                     if any_active and self.disagg_role == "prefill":
                         # slots on a prefill pool evacuate at prefill
                         # sync (_handoff_slot), so decode steps pipelined
@@ -2319,7 +2446,7 @@ class LLMEngine:
         # fallback inside _admit_handoff re-parks the request blob-less,
         # so the next round admits it below as a normal recompute.
         handed: List[GenerationRequest] = []
-        if self.disagg_role == "decode":
+        if self._lands_handoffs:
             handed = [r for r in taken if r.handoff_blobs is not None]
             if handed:
                 taken = [r for r in taken if r.handoff_blobs is None]

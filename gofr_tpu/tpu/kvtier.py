@@ -374,6 +374,18 @@ class HostKVTier:
         with self._lock:
             return list(self._blobs)
 
+    def inventory(self, limit: int = 64) -> List[Dict[str, Any]]:
+        """The newest ``limit`` resident pages as ``{key, tokens}`` rows —
+        the warm-boot pre-warm feed (fleet elasticity): a booting replica
+        fetches a peer's inventory and issues ``get(key, tokens)`` against
+        its OWN tier, so shared-cold-tier (Redis) hits promote straight
+        into host RAM before the first request arrives. Keys alone would
+        not do: ``get`` content-verifies against the token window."""
+        with self._lock:
+            rows = [(k, b.tokens) for k, b in self._blobs.items()]
+        rows = rows[-max(0, int(limit)):] if limit else []
+        return [{"key": int(k), "tokens": list(t)} for k, t in rows]
+
     def stats(self) -> Dict[str, Any]:
         with self._lock:
             now = time.monotonic()

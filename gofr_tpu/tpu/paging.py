@@ -90,6 +90,7 @@ class PagedLLMEngine(LLMEngine):
     """
 
     _plan_paged = True  # capacity plan without the dense-cache transients
+    supports_kv_handoff = True  # _admit_handoff can land shipped PageBlobs
 
     def __init__(self, params, cfg: LlamaConfig, *, page_size: int = 128,
                  n_pages: Optional[int] = None, prefix_cache: bool = False,
@@ -397,6 +398,71 @@ class PagedLLMEngine(LLMEngine):
         the preempted request's re-prefill will mostly be a prefix hit)."""
         self._release_slot_pages(slot)
         super()._release_slot_for_preempt(slot)
+
+    def tier_inventory(self, limit: int = 64):
+        """Bounded {key, tokens} listing of the host tier's newest pages —
+        served at /debug/kvtier for peers' warm-boot pre-warm."""
+        if self.kv_tier is None:
+            return []
+        return self.kv_tier.inventory(limit)
+
+    def prewarm_from_tier(self, entries, limit: int = 64) -> int:
+        """Warm-boot pre-warm: pull peer-advertised pages into host RAM
+        through the tier's own get() (shared cold tier hits promote, and
+        every page is content-verified against its token window). Runs
+        off the serving path at boot; returns pages now resident."""
+        if self.kv_tier is None:
+            return 0
+        warmed = 0
+        for row in list(entries)[:max(0, int(limit))]:
+            try:
+                key = int(row["key"])
+                tokens = [int(t) for t in row["tokens"]]
+            except (KeyError, TypeError, ValueError):
+                continue
+            if self.kv_tier.get(key, tokens) is not None:
+                warmed += 1
+        if warmed:
+            self._obs.counter("app_tpu_elastic_prewarm_pages_total", warmed)
+        return warmed
+
+    def _export_slot_kv(self, slot, request):
+        """Migration export for a LIVE decode slot: the _handoff_slot D2H
+        recipe generalized past the prefill boundary — the pages cover
+        slot.length positions (prompt + all-but-the-last emitted token),
+        so the peer's _admit_handoff content-verify window matches
+        exactly. Any mismatch (mid-flight oddity, no pages) degrades to
+        the blob-less export — peer-side recompute, never a wrong blob."""
+        n_ctx = slot.length
+        if (slot.pages is None or n_ctx <= 0
+                or n_ctx != len(request.resume_tokens) - 1):
+            return None, max(0, len(request.resume_tokens) - 1)
+        from .kvtier import PageBlob
+
+        ps = self.page_size
+        window = request.resume_tokens[:n_ctx]
+        n_kv = self.allocator.pages_for(n_ctx)
+        try:
+            ids = np.asarray(slot.pages[:n_kv], dtype=np.int32)
+            pulls = [self.k_cache[:, ids], self.v_cache[:, ids]]
+            if self._q8:
+                pulls += [self.k_scale[:, ids], self.v_scale[:, ids]]
+            host = self._fetch_host(*pulls)
+        except Exception as exc:  # noqa: BLE001 - a failed pull degrades to replay
+            if self.logger is not None:
+                self.logger.errorf("migration KV pull failed for %s: %s",
+                                   request.id, exc)
+            return None, n_ctx
+        k, v = host[0], host[1]
+        ks, vs = (host[2], host[3]) if self._q8 else (None, None)
+        blobs = []
+        for i in range(n_kv):
+            blobs.append(PageBlob(
+                tuple(window[i * ps:(i + 1) * ps]),
+                k[:, i], v[:, i],
+                None if ks is None else ks[:, i],
+                None if vs is None else vs[:, i]))
+        return blobs, n_ctx
 
     def _finish_slot(self, slot) -> None:
         self._release_slot_pages(slot)

@@ -30,7 +30,12 @@ drives a shed ladder —
                             prompt + emitted (resume_tokens) and the
                             client's stream pauses — no token is ever
                             re-emitted or dropped
-    level 3  shed_standard  standard submits get 503 + Retry-After;
+    level 3  request_replica nothing local degrades further: the level
+                            advertises scaleout_wanted to the fleet
+                            (/stats digest) so the elastic autoscaler
+                            (fleet/elastic.py) adds a replica before
+                            any standard request is failed
+    level 4  shed_standard  standard submits get 503 + Retry-After;
                             interactive is NEVER shed by the ladder
 
 — escalating one level per dwell while interactive burn stays over the
@@ -63,7 +68,14 @@ CLASSES = ("interactive", "standard", "batch")
 # keeps engine-internal negative priorities (replays, hand-offs) on top
 CLASS_BAND = {"interactive": 0, "standard": 30, "batch": 60}
 
-LEVEL_LABELS = ("ok", "park_batch", "preempt_batch", "shed_standard")
+LEVEL_LABELS = ("ok", "park_batch", "preempt_batch", "request_replica",
+                "shed_standard")
+# request_replica degrades NOTHING locally: it advertises scale-out
+# pressure (scaleout_wanted, published to the fleet via /stats) so the
+# elastic autoscaler can add a replica BEFORE the ladder starts failing
+# standard traffic. Shedding is the last rung, not the next one.
+SCALEOUT_LEVEL = LEVEL_LABELS.index("request_replica")
+SHED_LEVEL = LEVEL_LABELS.index("shed_standard")
 
 # per-class goodput window (seconds): recent-completion accounting for
 # the /debug/qos payload and the app_tpu_qos_goodput gauge
@@ -319,6 +331,10 @@ class QoSController:
         info = {"from": LEVEL_LABELS[self.level], "to": LEVEL_LABELS[level],
                 "level": level, "tracks": dict(states), "t": now}
         self._transitions.append(info)
+        if level >= SCALEOUT_LEVEL > self.level:
+            # crossing INTO scale-out territory: one ask per escalation,
+            # the autoscaler's dwell gating absorbs repeats
+            self._obs.counter("app_tpu_qos_scaleout_requests_total")
         self.level = level
         self._level_since = now
         if self.recorder is not None:
@@ -334,16 +350,25 @@ class QoSController:
             self._set_level_locked(max(0, min(len(LEVEL_LABELS) - 1,
                                               int(level))), self._clock(), {})
 
+    @property
+    def scaleout_wanted(self) -> bool:
+        """True at the request_replica rung and above — the replica's
+        standing ask for more capacity, advertised to the fleet through
+        the /stats digest and consumed by fleet.elastic.FleetAutoscaler."""
+        with self._lock:
+            return self.level >= SCALEOUT_LEVEL
+
     # -- submit-side gate (any thread) ----------------------------------------
     def check_submit(self, qos_class: Optional[str], tenant: str = "") -> None:
         """Ladder door check, called by engine.submit BEFORE the request
         object exists. Standard (and unclassified-as-standard) submits
-        shed with 503 + Retry-After at level 3; batch always enters (it
-        parks, it never fails); interactive is never ladder-shed."""
+        shed with 503 + Retry-After at the shed_standard rung; batch
+        always enters (it parks, it never fails); interactive is never
+        ladder-shed."""
         cls = qos_class or "standard"
         with self._lock:
             level = self.level
-            if level >= 3 and cls == "standard":
+            if level >= SHED_LEVEL and cls == "standard":
                 self._ledgers[cls].shed += 1
                 self._obs.counter("app_tpu_qos_shed_total",
                                   **{"class": cls})
@@ -502,6 +527,7 @@ class QoSController:
                 "ladder": {
                     "level": self.level,
                     "state": LEVEL_LABELS[self.level],
+                    "scaleout_wanted": self.level >= SCALEOUT_LEVEL,
                     "since_s": round(now - self._level_since, 1),
                     "shed_tracks": list(self.shed_tracks),
                     "escalate_hold_s": self.escalate_hold_s,
@@ -757,11 +783,14 @@ def register_qos_metrics(metrics) -> None:
          "Running generations preempted (replay-requeued) by class"),
         ("app_tpu_qos_expired_total",
          "Queued requests failed past their class deadline budget"),
+        ("app_tpu_qos_scaleout_requests_total",
+         "Ladder escalations into request_replica: asks for the elastic "
+         "autoscaler to add a replica before shedding starts"),
     ]
     gauges = [
         ("app_tpu_qos_shed_level",
          "QoS shed ladder level: 0 ok, 1 park batch, 2 preempt batch, "
-         "3 shed standard"),
+         "3 request replica, 4 shed standard"),
         ("app_tpu_qos_goodput",
          "Fraction of recent completions that finished clean, by class"),
         ("app_tpu_qos_lane_depth",

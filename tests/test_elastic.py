@@ -1,0 +1,475 @@
+"""Elastic fleet: drain-with-migration, warm-boot routing gates, and the
+autoscaler reconciler.
+
+The load-bearing assertions (ISSUE 18 acceptance criteria):
+  - a session migrated mid-stream between two engines produces EXACTLY
+    the tokens an unmigrated run produces (greedy equality across the hop)
+  - migration failure degrades to a local resume — the client stream
+    completes token-exact, nothing is dropped (the replay-ladder floor)
+  - begin_drain is idempotent; the double-drain fat-finger is a no-op
+  - the autoscaler's dwell gating absorbs a flapping demand signal
+    (no oscillation) while sustained demand actuates exactly once
+  - warming/draining replicas are excluded from routing, and a drain
+    announcement drops learned affinity NOW, not at the eventual DOWN
+  - the router's /debug/fleet/elastic + /debug/fleet/drain/{replica}
+    surface works end-to-end over live HTTP
+"""
+
+import json
+import threading
+import time
+import types
+import urllib.error
+import urllib.request
+
+import pytest
+
+from gofr_tpu import App, Stream
+from gofr_tpu.config import MockConfig
+from gofr_tpu.datasource import Health, STATUS_UP
+from gofr_tpu.fleet.elastic import FleetAutoscaler, InProcessLauncher
+from gofr_tpu.fleet.registry import FleetRegistry, Replica
+from gofr_tpu.models.llama import LlamaConfig, llama_init
+from gofr_tpu.tpu.faults import FaultPlane
+from gofr_tpu.tpu.migrate import Lifecycle, MigrationCoordinator
+from gofr_tpu.tpu.paging import PagedLLMEngine
+
+from tests.test_fleet import _load
+
+pytestmark = pytest.mark.elastic
+
+CFG = LlamaConfig.debug()
+
+
+class MockLogger:
+    def debugf(self, *a): pass
+    def infof(self, *a): pass
+    def warnf(self, *a): pass
+    def errorf(self, *a): pass
+
+
+def _make_engine(**kw):
+    params = llama_init(CFG, seed=0)
+    defaults = dict(n_slots=4, max_seq_len=64, prefill_buckets=(8, 16),
+                    page_size=8, logger=MockLogger())
+    defaults.update(kw)
+    eng = PagedLLMEngine(params, CFG, **defaults)
+    eng.start()
+    return eng
+
+
+def _make_slow_engine(delay_s=0.05):
+    """Engine whose decode dispatches are throttled by the fault plane so
+    a generation stays LIVE long enough to migrate deterministically —
+    the debug model otherwise finishes a 32-token budget in ~5 ms."""
+    plane = FaultPlane([{"site": "engine.decode", "action": "delay",
+                         "every": 1, "times": 0, "delay_s": delay_s}])
+    return _make_engine(decode_block_size=1, faults=plane)
+
+
+def _wait(predicate, timeout_s=10.0, what="condition"):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+# -- migration: the golden equality -------------------------------------------
+def test_migrated_session_token_equality():
+    """A stream exported from engine A mid-generation and landed on
+    engine B via the hand-off path continues token-for-token identical
+    to an unmigrated greedy run — KV pages travel, nothing recomputes
+    differently, nothing is re-emitted or skipped."""
+    prompt = [5, 6, 7, 8, 9]
+    a = _make_slow_engine()
+    b = _make_engine()
+    try:
+        want = b.generate(prompt, max_new_tokens=32, temperature=0.0)
+
+        req = a.submit(prompt, max_new_tokens=32, temperature=0.0)
+        stream = req.stream(timeout_s=30.0)
+        got = [next(stream)]  # slot is live before the export round
+
+        exported = []
+        a.request_migration(
+            lambda r, blobs, n_ctx: exported.append((r, blobs, n_ctx)) or True)
+        _wait(lambda: not a.migration_pending, what="export round")
+        assert len(exported) == 1, "the live slot must export exactly once"
+        xreq, blobs, n_ctx = exported[0]
+        assert xreq is req
+        assert n_ctx == len(req.prompt_tokens) + len(req.emitted) - 1
+        assert a.migrations_total == 1
+
+        # peer half: same call POST /migrate's admit_migration makes —
+        # shared out_queue means the client stream never changes hands
+        b.submit_handoff(req.prompt_tokens, list(req.emitted),
+                         max_new_tokens=req.max_new_tokens,
+                         temperature=0.0, out_queue=req.out_queue,
+                         cancelled=req.cancelled, blobs=blobs)
+        got.extend(stream)
+        assert got == want, "migrated stream diverged from golden run"
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_migration_failure_degrades_to_local_resume():
+    """Every peer unreachable: the coordinator's ship ladder falls back
+    to resuming the session on the draining engine itself (admission is
+    still open — migration runs BEFORE engine.drain), and the client
+    sees a complete, token-exact stream. Zero loss is the floor."""
+    prompt = [3, 1, 4, 1, 5]
+    a = _make_slow_engine(delay_s=0.03)
+    try:
+        want = a.generate(prompt, max_new_tokens=24, temperature=0.0)
+
+        def refuse(address):
+            raise OSError(f"connect refused: {address}")
+
+        coord = MigrationCoordinator(a, Lifecycle("serving"),
+                                     client_factory=refuse,
+                                     ship_timeout_s=5.0)
+        req = a.submit(prompt, max_new_tokens=24, temperature=0.0)
+        stream = req.stream(timeout_s=30.0)
+        got = [next(stream)]
+        coord.begin_drain(["http://127.0.0.1:9"], timeout_s=20.0)
+        got.extend(stream)
+
+        assert got == want, "local resume broke greedy equality"
+        assert req.error is None
+        _wait(lambda: coord.status()["drained"], what="drain completion")
+        status = coord.status()
+        assert status["outcomes"]["local_resume"] == 1
+        assert status["outcomes"]["failed"] == 0
+        assert status["lifecycle"]["state"] == "draining"
+        [session] = status["sessions"]
+        assert session["outcome"] == "local_resume"
+    finally:
+        a.stop()
+
+
+# -- drain idempotence --------------------------------------------------------
+class _FakeEngine:
+    """Just enough engine for coordinator unit tests."""
+
+    _plane = None
+    _lands_handoffs = False
+    migrations_total = 0
+    migration_pending = False
+
+    def __init__(self):
+        self.drain_calls = 0
+
+    def request_migration(self, sink):
+        pass
+
+    def drain(self, timeout_s=30.0):
+        self.drain_calls += 1
+        return True
+
+
+def test_begin_drain_is_idempotent():
+    eng = _FakeEngine()
+    lifecycle = Lifecycle("serving")
+    coord = MigrationCoordinator(eng, lifecycle)
+
+    first = coord.begin_drain()
+    assert first["drain_started"] is True
+    assert lifecycle.state == "draining"
+    _wait(lambda: coord.status()["drained"], timeout_s=5.0,
+          what="no-session drain")
+    assert eng.drain_calls == 1
+
+    second = coord.begin_drain()  # operator fat-finger: observe, don't redo
+    assert second["drain_started"] is True
+    time.sleep(0.05)
+    assert eng.drain_calls == 1, "double drain must not re-run the machinery"
+    assert len(lifecycle.snapshot()["trail"]) == 1
+    # draining is terminal: no transition un-drains a replica
+    assert lifecycle.to("serving") is False
+    assert lifecycle.state == "draining"
+
+
+# -- autoscaler hysteresis ----------------------------------------------------
+def _spy_replica(name):
+    return types.SimpleNamespace(name=name, scaleout_wanted=False,
+                                 effective_lifecycle="serving",
+                                 available=lambda: True)
+
+
+class _SpyRegistry:
+    def __init__(self, n=1):
+        self.replicas = [_spy_replica(f"r{i}") for i in range(n)]
+        self.added = []
+
+    def add_replica(self, name, address, lifecycle_override="warming"):
+        self.added.append((name, address, lifecycle_override))
+        self.replicas = self.replicas + [_spy_replica(name)]
+
+
+def _autoscaler(registry, clock, capacity_fn, **kw):
+    router = types.SimpleNamespace(registry=registry)
+    launcher = InProcessLauncher(lambda name: f"http://test/{name}")
+    defaults = dict(min_replicas=1, max_replicas=4, up_hold_s=5.0,
+                    down_hold_s=30.0, cooldown_s=30.0, clock=clock,
+                    capacity_fn=capacity_fn)
+    defaults.update(kw)
+    return FleetAutoscaler(router, launcher, **defaults)
+
+
+def test_autoscaler_flapping_demand_never_oscillates():
+    """replicas_needed flapping 2/1/2/1 every tick: the direction reset
+    restarts the dwell clock each time, so nothing ever actuates."""
+    now = [0.0]
+    needed = [1]
+    reg = _SpyRegistry(n=1)
+    scaler = _autoscaler(reg, lambda: now[0],
+                         lambda: {"replicas_needed": needed[0]})
+    for tick in range(20):
+        now[0] = float(tick)
+        needed[0] = 2 if tick % 2 == 0 else 1
+        scaler.evaluate()
+    assert reg.added == []
+    assert scaler.scale_events == {"up": 0, "down": 0}
+    assert all(d["action"] == "none" for d in scaler.decisions)
+
+
+def test_autoscaler_sustained_demand_launches_once_then_cools():
+    now = [0.0]
+    reg = _SpyRegistry(n=1)
+    scaler = _autoscaler(reg, lambda: now[0],
+                         lambda: {"replicas_needed": 2})
+    record = scaler.evaluate()          # t=0: dwell starts
+    assert record["action"] == "none" and record["reason"] == "dwell"
+    now[0] = 6.0
+    record = scaler.evaluate()          # past up_hold_s: actuate
+    assert record["action"] == "launched auto0"
+    assert reg.added == [("auto0", "http://test/auto0", "warming")]
+    assert scaler.scale_events["up"] == 1
+    now[0] = 8.0
+    record = scaler.evaluate()          # inside cooldown: hold position
+    assert record["action"] == "none"
+    assert reg.added == [("auto0", "http://test/auto0", "warming")]
+    snap = scaler.snapshot()
+    assert snap["launched"] == ["auto0"]
+    assert snap["scale_events"] == {"up": 1, "down": 0}
+
+
+def test_autoscaler_scaleout_rung_outranks_steady_sizing():
+    """A replica screaming request_replica (QoS shed ladder) forces
+    desired to current+1 even when the M/M/c sizing says steady."""
+    now = [0.0]
+    reg = _SpyRegistry(n=1)
+    reg.replicas[0].scaleout_wanted = True
+    scaler = _autoscaler(reg, lambda: now[0],
+                         lambda: {"replicas_needed": 1}, up_hold_s=0.0)
+    record = scaler.evaluate()
+    assert record["desired"] == 2
+    assert record["scaleout_wanted"] == ["r0"]
+    assert record["action"] == "launched auto0"
+
+
+# -- registry lifecycle gating ------------------------------------------------
+def test_lifecycle_gates_availability_and_drain_drops_affinity():
+    r0 = Replica("r0", "http://127.0.0.1:1", logger=MockLogger())
+    r1 = Replica("r1", "http://127.0.0.1:2", logger=MockLogger())
+    reg = FleetRegistry([r0, r1], logger=MockLogger())
+
+    # a launched replica joins warming: never routable before its own
+    # advertisement flips serving, even though its state is not DOWN
+    added = reg.add_replica("auto0", "http://127.0.0.1:3")
+    assert added.effective_lifecycle == "warming"
+    assert not added.available()
+    assert reg.add_replica("auto0", "http://other") is added  # idempotent
+    added.lifecycle_override = None
+    assert added.available(), "cleared override must restore routability"
+
+    # drain announcement: unroutable NOW and learned affinity drops NOW
+    reg.affinity_map.learn(["k1", "k2"], "r0")
+    reg.affinity_map.learn(["k3"], "r1")
+    dropped = reg.announce_drain("r0")
+    assert dropped == 2
+    assert r0.effective_lifecycle == "draining"
+    assert not r0.available()
+    assert reg.candidates() and all(r.name != "r0" for r in reg.candidates())
+    assert reg.affinity_map.lookup(["k1"]) == (None, None)
+    assert reg.affinity_map.lookup(["k3"]) == ("r1", "k3")
+    assert reg.announce_drain("ghost") is None
+
+    assert reg.remove_replica("auto0") is True
+    assert reg.replica("auto0") is None
+
+
+# -- end-to-end over live HTTP ------------------------------------------------
+class _ElasticStub:
+    """llm-server-shaped replica advertising a lifecycle and honouring
+    the drain order — what the router's drain orchestrator talks to."""
+
+    def __init__(self, name, lifecycle="serving"):
+        self.name = name
+        self.state = {"lifecycle": lifecycle, "drained": False}
+        self.served = []
+        self.drain_orders = []
+        app = App(config=MockConfig({
+            "HTTP_PORT": "0", "METRICS_PORT": "0", "APP_NAME": name,
+            "REQUEST_TIMEOUT": "30", "LOG_LEVEL": "ERROR"}))
+        st = self.state
+
+        app.container.add_health_contributor(
+            "engine", lambda: Health(status=STATUS_UP, details={}))
+
+        @app.post("/generate")
+        def generate(ctx):
+            body = ctx.bind()
+            self.served.append(body.get("prompt"))
+
+            def chunks():
+                yield {"text": f"{name}-t0"}
+                yield {"done": True, "tokens": 1}
+
+            return Stream(chunks(), sse=True)
+
+        @app.get("/stats")
+        def stats(ctx):  # noqa: ARG001
+            return {"queue_depth": 0, "active_slots": 0,
+                    "fleet": {"duty_cycle": 0.25,
+                              "lifecycle": st["lifecycle"],
+                              "affinity": {"block": 8,
+                                           "generation": f"{name}-gen1",
+                                           "keys": []}}}
+
+        @app.post("/debug/drain")
+        def drain_order(ctx):
+            self.drain_orders.append(ctx.bind())
+            st["lifecycle"] = "draining"
+            st["drained"] = True
+            return {"drain_started": True, "drained": st["drained"]}
+
+        @app.get("/debug/drain")
+        def drain_status(ctx):  # noqa: ARG001
+            return {"drain_started": st["drained"],
+                    "drained": st["drained"]}
+
+        self.app = app
+
+    def start(self):
+        self.app.start()
+        self.url = f"http://127.0.0.1:{self.app.http_port}"
+        return self
+
+    def stop(self):
+        self.app.shutdown()
+
+
+class _ElasticHarness:
+    def __init__(self, lifecycles=("serving", "serving")):
+        self.replicas = [_ElasticStub(f"r{i}", lifecycle=lc).start()
+                         for i, lc in enumerate(lifecycles)]
+        self.app = _load("router").build_app(config=MockConfig({
+            "HTTP_PORT": "0", "METRICS_PORT": "0", "APP_NAME": "router",
+            "REQUEST_TIMEOUT": "30", "LOG_LEVEL": "ERROR",
+            "FLEET_REPLICAS": ",".join(f"{r.name}={r.url}"
+                                       for r in self.replicas),
+            "FLEET_PROBE_S": "0.2", "ELASTIC_INTERVAL_S": "0.5",
+            "DRAIN_TIMEOUT_S": "5",
+        }))
+        self.app.start()
+        self.port = self.app.http_port
+
+    def get(self, path):
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{self.port}{path}",
+                    timeout=10) as resp:
+                return resp.status, json.loads(resp.read().decode())
+        except urllib.error.HTTPError as err:
+            return err.code, json.loads(err.read().decode() or "null")
+
+    def post(self, path, body):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{self.port}{path}",
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        try:
+            with urllib.request.urlopen(req, timeout=15) as resp:
+                return resp.status, json.loads(resp.read().decode())
+        except urllib.error.HTTPError as err:
+            return err.code, json.loads(err.read().decode() or "null")
+
+    def generate(self, prompt):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{self.port}/generate",
+            data=json.dumps({"prompt": prompt, "stream": True}).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return resp.status
+
+    def wait_fleet(self, predicate, timeout=6.0):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            _, payload = self.get("/debug/fleet")
+            if predicate(payload["data"]):
+                return payload["data"]
+            time.sleep(0.1)
+        raise AssertionError("probe condition not reached")
+
+    def close(self):
+        self.app.shutdown()
+        for r in self.replicas:
+            r.stop()
+
+
+def test_elastic_debug_surface_end_to_end():
+    """Real examples/router over lifecycle-advertising stubs: warming
+    replicas receive no traffic, /debug/fleet/elastic exposes the
+    reconciler, and the operator drain endpoint runs the full
+    announce -> order -> poll orchestration."""
+    h = _ElasticHarness(lifecycles=("serving", "warming"))
+    try:
+        snap = h.wait_fleet(
+            lambda s: {r["name"]: r.get("lifecycle")
+                       for r in s["replicas"]} == {"r0": "serving",
+                                                   "r1": "warming"})
+        for _ in range(3):
+            assert h.generate("elastic-e2e prompt") == 200
+        assert len(h.replicas[0].served) == 3
+        assert h.replicas[1].served == [], "warming replica got traffic"
+        snap = h.wait_fleet(
+            lambda s: s.get("route_skips", {}).get("warming", 0) >= 1)
+        assert snap["route_skips"]["warming"] >= 1
+
+        # warm boot finishes: the replica's own advertisement flips it in
+        h.replicas[1].state["lifecycle"] = "serving"
+        h.wait_fleet(lambda s: all(r.get("lifecycle") == "serving"
+                                   for r in s["replicas"]))
+
+        status, payload = h.get("/debug/fleet/elastic")
+        assert status == 200
+        elastic = payload["data"]
+        assert elastic["launcher"] is None  # observe-and-drain default
+        assert {r["name"] for r in elastic["replicas"]} == {"r0", "r1"}
+
+        # operator drain: announce + order + poll, replica kept in place
+        status, payload = h.post("/debug/fleet/drain/r0",
+                                 {"migrate": True, "remove": False})
+        assert status in (200, 201)
+        out = payload["data"]
+        assert out["drained"] is True and out["removed"] is False
+        [order] = h.replicas[0].drain_orders
+        assert order["peers"] == [h.replicas[1].url]
+        assert order["migrate"] is True
+
+        h.wait_fleet(lambda s: any(r["name"] == "r0"
+                                   and r.get("lifecycle") == "draining"
+                                   for r in s["replicas"]))
+        served_before = len(h.replicas[1].served)
+        assert h.generate("post-drain prompt") == 200
+        assert len(h.replicas[1].served) == served_before + 1
+        assert len(h.replicas[0].served) == 3, "draining replica got traffic"
+
+        status, _ = h.post("/debug/fleet/drain/ghost", {})
+        assert status == 404
+    finally:
+        h.close()

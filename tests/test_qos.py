@@ -164,11 +164,17 @@ def test_ladder_walk_and_auto_recovery():
     now[0] += 5.0
     assert ctl.evaluate() == 2
     now[0] += 5.0
-    assert ctl.evaluate() == 3          # capped at shed_standard
-    now[0] += 5.0
+    # request_replica degrades nothing locally: the door stays open and
+    # the fleet sees the ask instead
     assert ctl.evaluate() == 3
-    # level 3 sheds standard (and unclassified-as-standard) with a duck
-    # 503 + Retry-After; interactive and batch always pass the door
+    assert ctl.scaleout_wanted
+    ctl.check_submit("standard")
+    now[0] += 5.0
+    assert ctl.evaluate() == 4          # capped at shed_standard
+    now[0] += 5.0
+    assert ctl.evaluate() == 4
+    # shed_standard sheds standard (and unclassified-as-standard) with a
+    # duck 503 + Retry-After; interactive and batch always pass the door
     with pytest.raises(QoSShedError) as exc:
         ctl.check_submit("standard")
     assert exc.value.status_code == 503
@@ -179,19 +185,25 @@ def test_ladder_walk_and_auto_recovery():
     ctl.check_submit("batch")
     # recovery: one level back down per recover_hold of all-OK
     states["ttft"] = "ok"
+    assert ctl.evaluate() == 4
+    now[0] += 10.0
     assert ctl.evaluate() == 3
+    assert ctl.scaleout_wanted          # still asking while at the rung
     now[0] += 10.0
     assert ctl.evaluate() == 2
+    assert not ctl.scaleout_wanted
     now[0] += 10.0
     assert ctl.evaluate() == 1
     now[0] += 10.0
     assert ctl.evaluate() == 0
     ctl.check_submit("standard")        # door open again
     trail = [t["to"] for t in ctl.snapshot()["ladder"]["transitions"]]
-    assert trail == ["park_batch", "preempt_batch", "shed_standard",
-                     "preempt_batch", "park_batch", "ok"]
+    assert trail == ["park_batch", "preempt_batch", "request_replica",
+                     "shed_standard", "request_replica", "preempt_batch",
+                     "park_batch", "ok"]
     assert [lbl for lbl in LEVEL_LABELS] == ["ok", "park_batch",
                                              "preempt_batch",
+                                             "request_replica",
                                              "shed_standard"]
 
 

@@ -322,7 +322,8 @@ def test_metric_inventory_consistency():
     assert any(n.startswith("app_tpu_capacity_") for n in recorded), \
         "capacity forecast gauges vanished from the inventory scan"
 
-    from gofr_tpu.fleet import (register_fleet_capacity_metrics,
+    from gofr_tpu.fleet import (register_elastic_metrics,
+                                register_fleet_capacity_metrics,
                                 register_fleet_metrics,
                                 register_fleet_slo_metrics,
                                 register_journey_metrics)
@@ -331,6 +332,7 @@ def test_metric_inventory_consistency():
     from gofr_tpu.tpu.flightrecorder import register_slo_gauges
     from gofr_tpu.tpu.incidents import register_incident_metrics
     from gofr_tpu.tpu.meter import register_meter_metrics
+    from gofr_tpu.tpu.migrate import register_migration_metrics
     from gofr_tpu.tpu.qos import register_qos_metrics
     from gofr_tpu.tpu.stepledger import register_step_metrics
 
@@ -349,6 +351,8 @@ def test_metric_inventory_consistency():
     register_incident_metrics(manager)
     register_qos_metrics(manager)
     register_meter_metrics(manager)
+    register_migration_metrics(manager)
+    register_elastic_metrics(manager)
     registered = set(manager._store)
     missing = recorded - registered
     assert not missing, (

@@ -8,9 +8,9 @@ the control plane shedding (QoS ladder per replica), and what did the
 last requests actually experience (recent journeys with attempts /
 TTFB / outcome). Everything comes from the operator surfaces the
 router and replicas already serve — `/debug/fleet`,
-`/debug/fleet/slo`, `/debug/fleet/capacity`, `/debug/journey`, and
-per-replica `/stats` + `/debug/qos` via the addresses the fleet
-snapshot advertises — so
+`/debug/fleet/slo`, `/debug/fleet/capacity`, `/debug/fleet/elastic`,
+`/debug/journey`, and per-replica `/stats` + `/debug/qos` via the
+addresses the fleet snapshot advertises — so
 grafttop needs no credentials, no agents, and nothing but stdlib.
 
 Usage:
@@ -52,6 +52,7 @@ def fetch(router: str) -> dict:
     for key, path in (("fleet", "/debug/fleet"),
                       ("fleet_slo", "/debug/fleet/slo"),
                       ("capacity", "/debug/fleet/capacity"),
+                      ("elastic", "/debug/fleet/elastic"),
                       ("journeys", "/debug/journey"),
                       ("qos", "/debug/qos")):
         try:
@@ -124,8 +125,8 @@ def render(data: dict, color: bool = False, width: int = 0) -> str:
 
     # -- replica table ------------------------------------------------------
     lines.append("")
-    lines.append(f"  {'replica':10} {'state':9} {'brk':3} {'shed':4} "
-                 f"{'queue':5} {'slots':5} {'duty':5} {'infl':4} "
+    lines.append(f"  {'replica':10} {'state':9} {'life':8} {'brk':3} "
+                 f"{'shed':4} {'queue':5} {'slots':5} {'duty':5} {'infl':4} "
                  f"{'breaks':6} {'slo':18}")
     replica_slo = slo.get("replicas") or {}
     for row in fleet.get("replicas", []):
@@ -139,6 +140,7 @@ def render(data: dict, color: bool = False, width: int = 0) -> str:
         stats = (data.get("replica_stats") or {}).get(name) or {}
         lines.append(
             f"  {name:10} {str(row.get('state', '-')):9} "
+            f"{str(row.get('lifecycle', '-')):8} "
             f"{'Y' if row.get('breaker_open') else '.':3} "
             f"{'Y' if row.get('shedding') else '.':4} "
             f"{str(row.get('queue_depth', '-')):5} "
@@ -213,6 +215,24 @@ def render(data: dict, color: bool = False, width: int = 0) -> str:
                          + ("!" if snap.get("collapse_warning") else ""))
         if marks:
             lines.append("  replica rho " + "  ".join(marks))
+
+    # -- elastic reconciler (ELASTIC=true routers) --------------------------
+    if "elastic" in data:
+        ela = data.get("elastic") or {}
+        events = ela.get("scale_events") or {}
+        decisions = ela.get("decisions") or []
+        last = decisions[-1] if decisions else {}
+        line = (f"  elastic launcher={ela.get('launcher') or 'none'}"
+                f"  up={events.get('up', 0)} down={events.get('down', 0)}"
+                f"  launched={','.join(ela.get('launched') or []) or '-'}"
+                f"  draining={','.join(ela.get('draining') or []) or '-'}")
+        if last:
+            line += (f"  last: need={last.get('needed', '-')}"
+                     f"/{last.get('current', '-')}"
+                     f" {last.get('action') or 'none'}"
+                     + (f" ({last.get('reason')})" if last.get("reason")
+                        else ""))
+        lines.append(line)
 
     # -- recent journeys ----------------------------------------------------
     lines.append("")

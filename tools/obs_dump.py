@@ -30,7 +30,11 @@ hidden-page count) and a `/debug/journey` digest with nearest-rank
 p50/p90/p99 over the ring's router-observed TTFB and stream duration —
 cross-hop tail evidence next to the per-replica kind — and the
 `/debug/fleet/capacity` rollup (fleet ρ/headroom, top fleet-wide
-tenants, `replicas_needed`).
+tenants, `replicas_needed`). ELASTIC=true routers add the
+`/debug/fleet/elastic` reconciler digest (launcher, launched/draining
+sets, scale events, last decisions), and replicas with drain-migration
+enabled add the `/debug/drain` ledger (lifecycle, per-session
+outcomes/gap_s — the zero-loss evidence).
 
 Usage:
     python tools/obs_dump.py [--server http://127.0.0.1:8000]
@@ -265,6 +269,36 @@ def poll_once(server: str, metrics_base: str) -> dict:
         }
     except Exception as exc:  # noqa: BLE001 - only router-tier processes serve it
         entry["fleet_capacity_error"] = str(exc)
+    try:
+        body = json.loads(_get(server.rstrip("/") + "/debug/fleet/elastic"))
+        snap = body.get("data", body)
+        # reconciler state + the last few decisions — enough to answer
+        # "why did/didn't it scale" without replaying the whole trail
+        entry["elastic"] = {
+            "launcher": snap.get("launcher"),
+            "launched": snap.get("launched"),
+            "draining": snap.get("draining"),
+            "scale_events": snap.get("scale_events"),
+            "replicas": snap.get("replicas"),
+            "decisions": snap.get("decisions", [])[-4:],
+        }
+    except Exception as exc:  # noqa: BLE001 - ELASTIC=false routers lack it
+        entry["elastic_error"] = str(exc)
+    try:
+        body = json.loads(_get(server.rstrip("/") + "/debug/drain"))
+        snap = body.get("data", body)
+        # replica-side drain ledger: lifecycle + per-session outcomes are
+        # the zero-loss evidence a drain post-mortem needs
+        entry["drain"] = {
+            "lifecycle": snap.get("lifecycle"),
+            "drain_started": snap.get("drain_started"),
+            "outcomes": snap.get("outcomes"),
+            "sessions": snap.get("sessions", [])[:5],
+            "migrations_total": snap.get("migrations_total"),
+            "drained": snap.get("drained"),
+        }
+    except Exception as exc:  # noqa: BLE001 - replicas without migration lack it
+        entry["drain_error"] = str(exc)
     try:
         body = json.loads(_get(server.rstrip("/") + "/debug/qos"))
         snap = body.get("data", body)
