@@ -592,6 +592,85 @@ def run_phase_fleet(sessions=6, turns=4, max_tokens=8):
             "fleet_affinity_hit_rate": hit_rate}
 
 
+def run_phase_loadgen(rate_rps=6.0, seconds=12.0):
+    """Open-loop traffic observatory (gofr_tpu/loadgen): a synthesized
+    Poisson trace replayed open-loop — arrivals fire on schedule
+    regardless of completions — against 2 debug replicas behind the
+    real router, scored by the SLO scorecard.
+
+    Unlike every closed-loop phase above, offered load here is
+    independent of service speed, so the offered-vs-served gap and the
+    dispatch-lag self-audit are real measurements: worst_lag_ms is the
+    generator proving it held the schedule while the system backed up.
+    Returns {loadgen_offered, loadgen_ok, loadgen_shed,
+    loadgen_ttft_p95_ms, loadgen_worst_lag_ms, loadgen_slo_met}."""
+    from gofr_tpu.config import MockConfig
+    from gofr_tpu.loadgen import (OpenLoopRunner, build_scorecard,
+                                  poisson_arrivals, synthesize)
+    from gofr_tpu.loadgen.scorecard import percentile
+    import random
+
+    llm = _load_example("llm-server")
+    router_mod = _load_example("router")
+    replicas = []
+    for i in range(2):
+        app = llm.build_app(config=MockConfig({
+            "HTTP_PORT": "0", "METRICS_PORT": "0", "GRPC_PORT": "0",
+            "APP_NAME": f"bench-ol-replica{i}", "MODEL_PRESET": "debug",
+            "PAGED": "true", "PAGE_SIZE": "16", "PREFIX_CACHE": "true",
+            "MAX_SEQ_LEN": "512", "MAX_BATCH": "4", "WARMUP": "true",
+            "REQUEST_TIMEOUT": "120", "LOG_LEVEL": "ERROR",
+            "QOS": "true", "PUBSUB_BACKEND": "inproc",
+            "INCIDENT_AUTOPSY": "false"}))
+        app.start()
+        replicas.append(app)
+    router_app = router_mod.build_app(config=MockConfig({
+        "HTTP_PORT": "0", "METRICS_PORT": "0", "APP_NAME": "bench-ol-router",
+        "REQUEST_TIMEOUT": "120", "LOG_LEVEL": "ERROR",
+        "FLEET_REPLICAS": ",".join(
+            f"r{i}=http://127.0.0.1:{a.http_port}"
+            for i, a in enumerate(replicas)),
+        "FLEET_PROBE_S": "0.5", "ELASTIC": "false"}))
+    router_app.start()
+    base = f"http://127.0.0.1:{router_app.http_port}"
+    try:
+        # warm-up absorbs the decode-batch compile storms so the phase
+        # measures serving, not XLA; word counts stay <= 6 because the
+        # debug tokenizer spends ~8 tokens per word against the
+        # 64-token admission limit
+        warm = synthesize(
+            poisson_arrivals(rate_rps, min(seconds, 8.0), random.Random(7)),
+            tenants=4, sessions=6, prompt_tokens=(2, 6), max_new=(4, 8),
+            seed=7)
+        OpenLoopRunner(base, warm, timeout_s=120.0,
+                       label="bench-ol-warm").run(drain_timeout_s=240.0)
+        events = synthesize(
+            poisson_arrivals(rate_rps, seconds, random.Random(8101)),
+            tenants=4, sessions=6, session_reuse=0.6,
+            prompt_tokens=(2, 6), max_new=(4, 8), seed=8101)
+        runner = OpenLoopRunner(base, events, timeout_s=120.0,
+                                label="bench-ol")
+        rows = runner.run(drain_timeout_s=240.0)
+        status = runner.status()
+    finally:
+        router_app.shutdown()
+        for app in replicas:
+            app.shutdown()
+    card = build_scorecard(rows)
+    ok_rows = [r for r in rows if r.get("status") == "ok"]
+    p95 = percentile([r["ttft_s"] * 1e3 for r in ok_rows
+                      if isinstance(r.get("ttft_s"), (int, float))], 95)
+    return {
+        "loadgen_offered": len(rows),
+        "loadgen_ok": len(ok_rows),
+        "loadgen_shed": (status["outcomes"] or {}).get("shed", 0),
+        "loadgen_ttft_p95_ms": round(p95, 1) if p95 is not None else None,
+        "loadgen_worst_lag_ms": round(
+            status["worst_dispatch_lag_s"] * 1e3, 1),
+        "loadgen_slo_met": card["slo_met"],
+    }
+
+
 def run_phase_qos(n_requests=12, max_tokens=8, lane_jobs=8,
                   lane_max_tokens=160):
     """QoS serving plane (gofr_tpu/tpu/qos.py): interactive TTFT/TPOT
@@ -1710,6 +1789,30 @@ def main() -> None:
               f"{exc}", file=sys.stderr)
         record.update(qos_error=f"{type(exc).__name__}: {exc}"[:200])
         _note_wedge(exc, record, "QS")
+
+    # ---- OL: open-loop loadgen — offered-vs-served over the router --------
+    # After QS for the same freed-host reason. The one phase whose
+    # arrival process does NOT slow down when the system does: dispatch
+    # lag proves the schedule held, the scorecard says what the fleet
+    # did with the offered load.
+    try:
+        if full_run and _left() > 150 and not _WEDGED:
+            ol = run_phase_loadgen()
+            print(f"[bench] OL loadgen: {ol['loadgen_ok']}"
+                  f"/{ol['loadgen_offered']} ok, ttft p95 "
+                  f"{ol['loadgen_ttft_p95_ms']}ms, worst lag "
+                  f"{ol['loadgen_worst_lag_ms']}ms, slo_met="
+                  f"{ol['loadgen_slo_met']} t={_spent():.0f}s",
+                  file=sys.stderr)
+            record.update(**ol)
+        elif full_run:
+            record.update(loadgen_skipped=("device wedged" if _WEDGED
+                                           else "budget"))
+    except Exception as exc:  # noqa: BLE001 - keep earlier phases' record
+        print(f"[bench] OL phase failed (earlier results preserved): "
+              f"{exc}", file=sys.stderr)
+        record.update(loadgen_error=f"{type(exc).__name__}: {exc}"[:200])
+        _note_wedge(exc, record, "OL")
 
     # ---- M2: BERT /embed over gRPC (BASELINE config 3, labeled extra) -----
     # Last on purpose: every LLM engine is stopped, so its HBM is free, and

@@ -461,11 +461,19 @@ def build_app(config=None, engine=None) -> App:
     # engine injected with its own recorder keeps it — enable_ only wires
     # the app's metrics/tracer sinks and the routes then
     if app.config.get_bool("FLIGHT_RECORDER", True):
-        app.enable_flight_recorder(engine)
+        recorder = app.enable_flight_recorder(engine)
         # journey surface: GET /debug/journey[/{id}] assembles this
         # replica's recorder(s) — both halves of a DISAGG both pair —
         # into the same hop waterfall the fleet router serves
         app.enable_journey(engine)
+        # traffic observatory: the recorder's request ring re-exported
+        # as a replayable loadgen trace at GET /debug/trace
+        # (FLIGHT_TRACE_EXPORT=false opts out)
+        if app.config.get_bool("FLIGHT_TRACE_EXPORT", True):
+            from gofr_tpu.loadgen.capture import \
+                install_recorder_trace_route
+
+            install_recorder_trace_route(app, recorder)
     # fleet-level sibling: GET /debug/engine (slots / page pool / compile
     # table / MFU-MBU utilization window) + HBM sampler; ENGINE_SNAPSHOT=
     # false opts out

@@ -76,9 +76,16 @@ def build_app(**kw) -> App:
     # per-request flight recorder + /debug/requests + SLO goodput gauges
     # (llm-server parity; FLIGHT_RECORDER=false opts out)
     if app.config.get_bool("FLIGHT_RECORDER", True):
-        app.enable_flight_recorder(engine)
+        recorder = app.enable_flight_recorder(engine)
         # uniform journey surface: GET /debug/journey[/{id}] here too
         app.enable_journey(engine)
+        # replayable loadgen trace at GET /debug/trace (llm-server
+        # parity; FLIGHT_TRACE_EXPORT=false opts out)
+        if app.config.get_bool("FLIGHT_TRACE_EXPORT", True):
+            from gofr_tpu.loadgen.capture import \
+                install_recorder_trace_route
+
+            install_recorder_trace_route(app, recorder)
     # GET /debug/engine + utilization gauges + HBM sampler (llm-server
     # parity; ENGINE_SNAPSHOT=false opts out)
     if app.config.get_bool("ENGINE_SNAPSHOT", True):

@@ -115,6 +115,19 @@ def build_app(config=None) -> App:
         # rho/replicas_needed must track probe reality while idle
         app.container.add_scrape_hook("fleet_capacity",
                                       router.capacity.publish)
+    # traffic observatory: record the fleet's observed arrival process
+    # (prompt specs only — token count + CRC seed, never text) as a
+    # replayable trace at GET /debug/trace (FLEET_TRACE_CAPTURE=false
+    # opts out)
+    if app.config.get_bool("FLEET_TRACE_CAPTURE", True):
+        from gofr_tpu.loadgen import TraceCapture
+        from gofr_tpu.loadgen.capture import \
+            install_routes as install_trace_routes
+
+        router.capture = TraceCapture(
+            capacity=app.config.get_int("FLEET_TRACE_CAPACITY", 4096),
+            block=app.config.get_int("FLEET_AFFINITY_BLOCK", 256))
+        install_trace_routes(app, router.capture)
     # elastic control plane: the autoscaler reconciler actuates what the
     # capacity rollup recommends (launch on sustained demand, drain with
     # live-session migration on sustained calm) and serves the operator

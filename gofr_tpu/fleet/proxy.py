@@ -68,10 +68,12 @@ class FleetRouter:
         # observability plane, attached by the router app (None-guarded
         # on every touch so the forwarding path never depends on it):
         # journeys = fleet/journey.py recorder, slo = fleet/slo.py
-        # rollup, capacity = fleet/capacity.py rollup
+        # rollup, capacity = fleet/capacity.py rollup, capture =
+        # loadgen/capture.py arrival-trace ring
         self.journeys = None
         self.slo = None
         self.capacity = None
+        self.capture = None
 
     @classmethod
     def from_config(cls, config, logger=None, metrics=None):
@@ -178,6 +180,10 @@ class FleetRouter:
         prompt = body.get("prompt", "")
         keys = affinity_keys(prompt, self.affinity_block,
                              self.affinity_max_blocks)
+        if self.capture is not None:
+            self.capture.note(prompt, qos_class=qos_class,
+                              tenant=body.get("tenant"),
+                              max_new=body.get("max_tokens"))
         journeys = self.journeys
         journey = None
         if journeys is not None:

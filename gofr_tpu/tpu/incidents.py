@@ -652,6 +652,25 @@ class IncidentManager:
                     return bundle
         return None
 
+    def export_trace(self, incident_id: int) -> Optional[Dict[str, Any]]:
+        """One bundle's ``slowest_requests`` as a replayable loadgen
+        trace document (header fields + ``events``) — the traffic shape
+        that blew the SLO, ready for ``tools/loadgen.py replay``. None
+        when the id is not in the ring."""
+        bundle = self.lookup(incident_id)
+        if bundle is None:
+            return None
+        # local import: loadgen is the traffic plane; the autopsy plane
+        # must not hard-depend on it at module import
+        from ..loadgen.trace import TRACE_VERSION, events_from_incident
+
+        events = events_from_incident(bundle)
+        return {"trace_version": TRACE_VERSION,
+                "source": f"incident:{incident_id}",
+                "trigger": bundle.get("trigger"),
+                "captured_at": bundle.get("captured_at"),
+                "events": events}
+
 
 def register_incident_metrics(metrics) -> None:
     """Register the autopsy-plane instruments on a metrics Manager
@@ -713,3 +732,17 @@ def install_routes(app, burn: SLOBurnEngine, incidents: IncidentManager,
                 f"{incidents.capacity} bundles; older files persist "
                 f"under {incidents.dir})", status_code=404)
         return bundle
+
+    @app.get(incidents_path + "/{id}/trace")
+    def debug_incident_trace(ctx):  # noqa: ANN001
+        raw = ctx.request.path_param("id")
+        try:
+            incident_id = int(raw)
+        except (TypeError, ValueError) as exc:
+            raise HTTPError(f"invalid incident id {raw!r}",
+                            status_code=400) from exc
+        trace = incidents.export_trace(incident_id)
+        if trace is None:
+            raise HTTPError(f"incident {incident_id} not in the ring",
+                            status_code=404)
+        return trace

@@ -151,6 +151,49 @@ def test_render_width_leaves_ansi_lines_whole():
             assert len(line) <= 40
 
 
+def test_render_loadgen_panel():
+    """--loadgen attaches the traffic panel: offered vs served, per-class
+    inflight/outcomes, and the live scorecard verdict line."""
+    data = _payload()
+    data["loadgen"] = {
+        "label": "knee", "offered_rps": 12.3, "served_rps": 8.0,
+        "arrivals_fired": 95, "events_total": 120, "inflight_total": 14,
+        "dropped": 2, "verdict": "pass",
+        "inflight": {"interactive": 9, "batch": 5},
+        "outcomes": {"ok": 70, "shed": 11},
+        "scorecard": {
+            "slo_met": True,
+            "classes": {"interactive": {"ttft_ms_p95": 812.5,
+                                        "goodput": 0.91}}},
+    }
+    frame = grafttop.render(data)
+    assert "loadgen knee" in frame
+    assert "offered=12.3rps" in frame and "served=8.0rps" in frame
+    assert "fired=95/120" in frame
+    assert "dropped=2" in frame
+    assert "verdict=pass" in frame
+    assert "interactive=9" in frame and "shed=11" in frame
+    assert "interactive:p95=812ms/good=0.91" in frame
+
+
+def test_render_loadgen_verdict_falls_back_to_scorecard():
+    """No explicit verdict string: the scorecard's slo_met boolean
+    renders as pass/REGRESS so the panel never shows a bare bool."""
+    data = {"t": 0, "loadgen": {"label": "lg", "scorecard":
+                                {"slo_met": False, "classes": {}}}}
+    assert "verdict=REGRESS" in grafttop.render(data)
+    data["loadgen"]["scorecard"]["slo_met"] = True
+    assert "verdict=pass" in grafttop.render(data)
+
+
+def test_render_loadgen_degrades():
+    """A dead generator is one error line, not a dead watcher — and an
+    absent --loadgen renders no panel at all."""
+    frame = grafttop.render({"t": 0, "loadgen_error": "conn refused"})
+    assert "loadgen: ERROR conn refused" in frame
+    assert "loadgen" not in grafttop.render({"t": 0})
+
+
 def test_bar_and_fmt_handle_non_numeric():
     assert grafttop._bar(None) == "-" * grafttop.BAR_WIDTH
     assert grafttop._bar(99.0, scale=1.0) == "#" * grafttop.BAR_WIDTH

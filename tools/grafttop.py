@@ -15,8 +15,13 @@ grafttop needs no credentials, no agents, and nothing but stdlib.
 
 Usage:
     python tools/grafttop.py [--router http://127.0.0.1:9000]
+                             [--loadgen http://127.0.0.1:9100]
                              [--interval 2] [--count 0] [--once]
                              [--plain] [--no-color] [--width N]
+
+--loadgen adds the traffic panel: a running open-loop generator's
+current offered vs served rps, per-class inflight, outcome counts, and
+the live scorecard verdict (tools/loadgen.py --status-port serves it).
 
 --once renders a single frame and exits (testable / scriptable);
 --plain skips the ANSI clear-screen so frames append (pipes, logs).
@@ -43,12 +48,20 @@ def _get_json(url: str, timeout: float = 5.0) -> dict:
     return body.get("data", body) if isinstance(body, dict) else body
 
 
-def fetch(router: str) -> dict:
+def fetch(router: str, loadgen: str = "") -> dict:
     """One poll: router surfaces + per-replica /stats and /debug/qos via
-    the addresses in the fleet snapshot. Every surface degrades to an
-    `<name>_error` key instead of raising."""
+    the addresses in the fleet snapshot, plus — when --loadgen points at
+    a running generator's StatusServer — the live offered-load panel.
+    Every surface degrades to an `<name>_error` key instead of
+    raising."""
     base = router.rstrip("/")
     out: dict = {"t": time.time()}
+    if loadgen:
+        try:
+            out["loadgen"] = _get_json(loadgen.rstrip("/")
+                                       + "/debug/loadgen")
+        except Exception as exc:  # noqa: BLE001 - generator may be gone
+            out["loadgen_error"] = str(exc)
     for key, path in (("fleet", "/debug/fleet"),
                       ("fleet_slo", "/debug/fleet/slo"),
                       ("capacity", "/debug/fleet/capacity"),
@@ -234,6 +247,44 @@ def render(data: dict, color: bool = False, width: int = 0) -> str:
                         else ""))
         lines.append(line)
 
+    # -- loadgen: offered vs served (only when a generator is attached) -----
+    if "loadgen_error" in data:
+        lines.append("")
+        lines.append(f"  loadgen: ERROR {data['loadgen_error']}")
+    elif "loadgen" in data:
+        lg = data.get("loadgen") or {}
+        lines.append("")
+        verdict = lg.get("verdict") or (lg.get("scorecard") or {}).get(
+            "slo_met")
+        card = lg.get("scorecard") or {}
+        mark = verdict if isinstance(verdict, str) else (
+            "-" if verdict is None else ("pass" if verdict else "REGRESS"))
+        lines.append(
+            f"  loadgen {lg.get('label', '-')}"
+            f"  offered={_fmt(lg.get('offered_rps'), 1)}rps"
+            f"  served={_fmt(lg.get('served_rps'), 1)}rps"
+            f"  fired={lg.get('arrivals_fired', '-')}"
+            f"/{lg.get('events_total', '-')}"
+            f"  inflight={lg.get('inflight_total', '-')}"
+            f"  dropped={lg.get('dropped', '-')}"
+            f"  verdict={mark}")
+        inflight = lg.get("inflight") or {}
+        outcomes = lg.get("outcomes") or {}
+        if inflight or outcomes:
+            lines.append(
+                "  loadgen classes "
+                + "  ".join(f"{cls}={n}" for cls, n
+                            in sorted(inflight.items()))
+                + ("  |  " if inflight and outcomes else "")
+                + "  ".join(f"{k}={v}" for k, v
+                            in sorted(outcomes.items())))
+        classes = card.get("classes") or {}
+        if classes:
+            lines.append("  loadgen slo " + "  ".join(
+                f"{cls}:p95={_fmt(row.get('ttft_ms_p95'), 0)}ms"
+                f"/good={_fmt(row.get('goodput'), 2)}"
+                for cls, row in sorted(classes.items())))
+
     # -- recent journeys ----------------------------------------------------
     lines.append("")
     if "journeys_error" in data:
@@ -261,6 +312,9 @@ def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--router", default="http://127.0.0.1:9000",
                     help="router HTTP base (serves /debug/fleet)")
+    ap.add_argument("--loadgen", default="",
+                    help="loadgen StatusServer base (serves "
+                         "/debug/loadgen); empty hides the panel")
     ap.add_argument("--interval", type=float, default=2.0)
     ap.add_argument("--count", type=int, default=0,
                     help="frames before exiting; 0 = until interrupted")
@@ -283,7 +337,8 @@ def main() -> int:
     n = 0
     try:
         while True:
-            frame = render(fetch(args.router), color=color, width=width)
+            frame = render(fetch(args.router, loadgen=args.loadgen),
+                           color=color, width=width)
             sys.stdout.write(clear + frame + "\n")
             sys.stdout.flush()
             n += 1

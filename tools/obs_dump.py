@@ -36,9 +36,16 @@ sets, scale events, last decisions), and replicas with drain-migration
 enabled add the `/debug/drain` ledger (lifecycle, per-session
 outcomes/gap_s — the zero-loss evidence).
 
+With --loadgen pointed at a running open-loop generator's StatusServer
+(tools/loadgen.py --status-port), every line also carries the traffic
+side: offered vs served rps (the gap IS the backlog), per-class
+inflight, outcome counts, and the live scorecard verdict — so the
+timeline shows what was OFFERED next to what the server did with it.
+
 Usage:
     python tools/obs_dump.py [--server http://127.0.0.1:8000]
                              [--metrics http://127.0.0.1:2121]
+                             [--loadgen http://127.0.0.1:9100]
                              [--interval 5] [--count 0]
                              [--out obs_dump.jsonl]
 
@@ -92,7 +99,8 @@ def scrape_gauges(metrics_base: str) -> dict:
     return out
 
 
-def poll_once(server: str, metrics_base: str) -> dict:
+def poll_once(server: str, metrics_base: str,
+              loadgen_base: str = "") -> dict:
     entry: dict = {"t": time.time()}
     try:
         body = json.loads(_get(server.rstrip("/") + "/debug/requests"))
@@ -318,6 +326,33 @@ def poll_once(server: str, metrics_base: str) -> dict:
         }
     except Exception as exc:  # noqa: BLE001 - QOS=false servers lack the route
         entry["qos_error"] = str(exc)
+    if loadgen_base:
+        try:
+            body = json.loads(_get(loadgen_base.rstrip("/")
+                                   + "/debug/loadgen"))
+            snap = body.get("data", body)
+            # offered vs served is the open-loop signal: a widening gap
+            # with flat served_rps IS queueing collapse, timestamped
+            # next to the server-side evidence above
+            lg = {k: snap.get(k) for k in (
+                "label", "offered_rps", "served_rps", "arrivals_fired",
+                "completions", "inflight_total", "inflight", "outcomes",
+                "dropped", "worst_dispatch_lag_s", "done", "elapsed_s",
+                "verdict")}
+            card = snap.get("scorecard")
+            if isinstance(card, dict):
+                # verdict-level summary only; the full scorecard lives
+                # in the run artifact tools/loadgen.py writes
+                lg["scorecard"] = {
+                    "slo_met": card.get("slo_met"),
+                    "classes": {
+                        cls: {k: row.get(k) for k in (
+                            "goodput", "ttft_ms_p95", "slo_met")}
+                        for cls, row in (card.get("classes")
+                                         or {}).items()}}
+            entry["loadgen"] = lg
+        except Exception as exc:  # noqa: BLE001 - generator may be gone
+            entry["loadgen_error"] = str(exc)
     try:
         entry["gauges"] = scrape_gauges(metrics_base)
     except Exception as exc:  # noqa: BLE001
@@ -331,6 +366,9 @@ def main() -> int:
                     help="app HTTP base (serves /debug/requests)")
     ap.add_argument("--metrics", default="http://127.0.0.1:2121",
                     help="metrics server base (serves /metrics)")
+    ap.add_argument("--loadgen", default="",
+                    help="loadgen StatusServer base (serves "
+                         "/debug/loadgen); empty skips the panel")
     ap.add_argument("--interval", type=float, default=5.0)
     ap.add_argument("--count", type=int, default=0,
                     help="polls before exiting; 0 = until interrupted")
@@ -343,7 +381,8 @@ def main() -> int:
     n = 0
     try:
         while True:
-            entry = poll_once(args.server, args.metrics)
+            entry = poll_once(args.server, args.metrics,
+                              loadgen_base=args.loadgen)
             fp.write(json.dumps(entry) + "\n")
             fp.flush()
             n += 1

@@ -1271,72 +1271,68 @@ def run_capacity(seconds: float, n_threads: int, preset: str) -> bool:
         stats["ramp"] = [{k: v for k, v in row.items()
                          if not k.startswith("_")} for row in ramp]
 
-        # ---- overload: a controlled walk past the knee -------------------
-        # a depth-targeting spawner grows the backlog LINEARLY (0 -> 60
-        # queued over the flood window) whatever this host's real service
-        # rate is, so the collapse detector sees its signal — sustained
-        # dq/dt > 0 at high rho — while measured TTFT is still degrading
-        # gradually, not after a step-function pile-up already blew it out
+        # ---- overload: the open-loop knee drill past the knee ------------
+        # loadgen's λ-ramp replaces the old ad-hoc depth-targeting
+        # flooder: arrivals fire on schedule whatever the host's real
+        # service rate, so queueing collapse is offered, not negotiated.
+        # The ramp is calibrated to THIS host from the closed-loop ramp
+        # stages — start under the measured service rate, finish at ~4x
+        # it — which recovers the old spawner's host-independence
+        from gofr_tpu.loadgen import run_knee
+
         flood_len = max(phase, 12.0)
-        flood_t0 = time.time()
-        flood_stop = flood_t0 + flood_len
-        flooders: list = []
-        blowout: list = []
+        mu_hat = max(1.0, ramp[2]["n"] / phase)       # measured req/s
+        # the ramp peak must actually overload: an arrival cap (the old
+        # spawner's 400, for slow hosts) is only allowed to trim the
+        # 4x-mu target down to 2.5x-mu — a fast host whose service rate
+        # exceeds the cap would otherwise run an "overload" stage that
+        # never crosses the knee and the warning could never arm
+        rate1 = max(2.5 * mu_hat,
+                    min(4.0 * mu_hat,
+                        max(2.0, 720.0 / flood_len - 0.5 * mu_hat)))
         # "blowout" is SLO-scale degradation — an order of magnitude off
         # the quiet baseline — not the first wobble past it; the early
         # warning must beat THAT, which is what a pager cares about
+        # (1s floor: on a host with a sub-125ms quiet baseline, 8x is
+        # still interactive — give the detector a pager-scale target)
         baseline_ms = (ramp[0]["ttft_p50_ms"] or 50.0)
-        blowout_ms = max(8.0 * baseline_ms, 600.0)
-
-        def flooded(widx: int) -> None:
-            # light requests: service stays fast, so the backlog depth at
-            # which TTFT blows out sits well above the warning depth —
-            # the drill probes the detector, not this host's crawl speed
-            t = _ttft("interactive" if widx % 2 else "standard",
-                      tenants[widx % len(tenants)], 2, 8)
-            if t is not None:
-                with lock:
-                    if t * 1e3 > blowout_ms:
-                        blowout.append(time.time())
-        samples: list = []
-        spawned = 0
-        while time.time() < flood_stop and spawned < 400:
-            progress = (time.time() - flood_t0) / flood_len
-            # gentle early slope (p^1.5): the knee should be approached,
-            # not stepped past — that is the regime the early warning is
-            # for, and the one an autoscaler could still act in
-            target_depth = int(60 * progress ** 1.5)
-            deficit = target_depth - engine.queue_depth()
-            for _ in range(max(0, min(deficit, 25))):
-                th = threading.Thread(target=flooded, args=(spawned,),
-                                      daemon=True)
-                th.start()
-                flooders.append(th)
-                spawned += 1
-            samples.append((time.time(), fc.evaluate()))
-            time.sleep(0.25)
-        # keep sampling while the backlog drains — the warning may arm
-        # after the spawn cap if the queue is still climbing
-        while time.time() < flood_stop + 300.0 and engine.queue_depth():
-            samples.append((time.time(), fc.evaluate()))
-            time.sleep(0.5)
-        collapse_at = next((t for t, s in samples
-                            if s["collapse_warning"]), None)
-        # let the flood drain so shutdown is clean (and the meter folds
-        # every request before the conservation readout)
-        for th in flooders:
-            th.join(timeout=300.0)
+        flood_t0 = time.time()
+        knee = run_knee(
+            base, lambda: fc.evaluate(),
+            rate0_rps=max(1.0, 0.5 * mu_hat), rate1_rps=rate1,
+            seconds=flood_len, seed=7, poll_s=0.25,
+            drain_timeout_s=300.0, request_timeout_s=300.0,
+            baseline_ttft_ms=baseline_ms, blowout_floor_ms=1000.0,
+            # light requests: service stays fast, so the backlog depth
+            # at which TTFT blows out sits well above the warning depth
+            # — the drill probes the detector, not this host's crawl
+            synth_kw={"tenants": len(tenants),
+                      "class_mix": {"interactive": 0.5, "standard": 0.5},
+                      "prompt_tokens": (2, 4), "max_new": (4, 8)})
+        with lock:
+            stats["ok"] += (knee["status"]["outcomes"] or {}).get("ok", 0)
+            stats["shed"] += (knee["status"]["outcomes"]
+                             or {}).get("shed", 0)
+            errors.extend(
+                str(r.get("error"))[:160] for r in knee["rows"]
+                if r.get("status") not in ("ok", "shed", "dropped"))
+        rel0 = flood_t0 - t0
         stats["overload"] = {
-            "spawned": spawned,
-            "queue_depth_max": max(
-                (s["queue_depth"] for _, s in samples), default=0),
-            "rho_max": max((s["rho"] for _, s in samples), default=0.0),
+            "spawned": knee["ramp"]["arrivals"],
+            "rate0_rps": round(knee["ramp"]["rate0_rps"], 2),
+            "rate1_rps": round(knee["ramp"]["rate1_rps"], 2),
+            "rho_max": knee["peak_rho"] or 0.0,
             "collapse_events": fc.collapse_events,
-            "collapse_at_s": (round(collapse_at - t0, 2)
-                              if collapse_at else None),
-            "first_blowout_at_s": (round(min(blowout) - t0, 2)
-                                   if blowout else None),
-            "blowout_ms": round(blowout_ms, 1),
+            "collapse_at_s": (round(rel0 + knee["collapse_warning_at_s"], 2)
+                              if knee["collapse_warning_at_s"] is not None
+                              else None),
+            "first_blowout_at_s": (round(rel0 + knee["first_blowout_at_s"],
+                                         2)
+                                   if knee["first_blowout_at_s"] is not None
+                                   else None),
+            "blowout_ms": knee["blowout_ttft_ms"],
+            "agrees": knee["agrees"],
+            "detail": knee["detail"],
         }
         drained = engine.drain(timeout_s=120)
     finally:
@@ -1759,12 +1755,209 @@ def run_elastic(seconds: float, n_threads: int, preset: str) -> bool:
     return ok
 
 
+def run_loadgen(seconds: float, n_threads: int, preset: str) -> bool:
+    """Traffic-observatory soak (gofr_tpu/loadgen): two replicas behind
+    the real router, all over sockets —
+
+      * **capture -> replay reproduces**: an open-loop synthetic run is
+        the "original" traffic; the router's capture ring exports what
+        it observed at GET /debug/trace; replaying THAT capture
+        open-loop must reproduce the original per-class SLO scorecard
+        within the declared noise band (verdict != regress);
+      * **knee cross-check**: a λ-ramp walks the fleet past its knee
+        while the PR-17 capacity rollup is polled over sockets
+        (/debug/fleet/capacity) — when measured TTFT blows past 8x the
+        quiet baseline, the forecaster's collapse warning must already
+        have fired.
+
+    Pass = zero hard request errors, a non-trivial capture, the replay
+    verdict not regress, and the knee agreement gate. The printed JSON
+    line is the machine-readable artifact CI archives."""
+    import importlib.util
+    import tempfile
+    import urllib.request
+
+    from gofr_tpu.config import MockConfig
+    from gofr_tpu.loadgen import (OpenLoopRunner, baseline_from_scorecard,
+                                  build_scorecard, compare,
+                                  poisson_arrivals, run_knee, synthesize)
+    from gofr_tpu.loadgen.scorecard import percentile
+
+    def _example(name):
+        path = os.path.join(os.path.dirname(__file__), "..", "examples",
+                            name, "main.py")
+        spec = importlib.util.spec_from_file_location(
+            "soak_loadgen_" + name.replace("-", "_"), path)
+        module = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(module)
+        return module
+
+    llm = _example("llm-server")
+    router_mod = _example("router")
+    small = preset == "debug"
+    replica_cfg = {
+        "HTTP_PORT": "0", "METRICS_PORT": "0", "GRPC_PORT": "0",
+        "MODEL_PRESET": preset, "PAGED": "true",
+        "PAGE_SIZE": "16" if small else "128",
+        "MAX_SEQ_LEN": "256" if small else "1024",
+        "PREFILL_BUCKETS": "16,64" if small else "64,128,256",
+        "MAX_BATCH": "4" if small else "16", "WARMUP": "true",
+        "REQUEST_TIMEOUT": "300", "LOG_LEVEL": "ERROR",
+        # QoS supplies the header -> tenant/class plumbing; the ladder
+        # stays dark (SLO parked out of reach below)
+        "QOS": "true", "PUBSUB_BACKEND": "inproc", "QOS_EVAL_S": "0.5",
+        # short λ window + low rho threshold: the knee ramp is a fast
+        # drill, so the forecaster must react within a few seconds —
+        # the production defaults (60s window) would warn postmortem
+        "CAPACITY_WINDOW_S": "4", "CAPACITY_RHO_WARN": "0.5",
+        "METER_REQUESTS": "4096",
+    }
+    replicas = []
+    for name in ("r0", "r1"):
+        app = llm.build_app(config=MockConfig(dict(
+            replica_cfg, APP_NAME=name, INCIDENT_DIR=os.path.join(
+                tempfile.mkdtemp(prefix="soak_loadgen_"), "incidents"))))
+        app.start()
+        app.slo_burn.slo_ttft_s = 999.0      # ladder stays dark
+        replicas.append(app)
+    router_app = router_mod.build_app(config=MockConfig({
+        "HTTP_PORT": "0", "METRICS_PORT": "0", "APP_NAME": "router",
+        "REQUEST_TIMEOUT": "300", "LOG_LEVEL": "ERROR",
+        "FLEET_REPLICAS": ",".join(
+            f"r{i}=http://127.0.0.1:{a.http_port}"
+            for i, a in enumerate(replicas)),
+        "FLEET_PROBE_S": "0.3", "ELASTIC": "false",
+        # queued streams must survive compile stalls and the knee
+        # flood's backlog: the 30s default read timeout would break
+        # them mid-wait and count as hard errors
+        "FLEET_TIMEOUT_S": "180",
+        "INCIDENT_DIR": tempfile.mkdtemp(prefix="soak_loadgen_inc_"),
+    }))
+    router_app.start()
+    base = f"http://127.0.0.1:{router_app.http_port}"
+
+    def _get_json(url, timeout=10):
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            body = json.loads(resp.read().decode())
+        return body.get("data", body) if isinstance(body, dict) else body
+
+    stats = {"profile": "loadgen", "preset": preset}
+    t0 = time.time()
+    phase = max(8.0, seconds / 3.0)
+    rate_a = max(3.0, float(n_threads))
+    try:
+        # ---- warm-up: absorb decode-batch compile storms off the books ---
+        # (the debug tokenizer spends ~8 tokens per trace word, so word
+        # counts stay <= 8 everywhere to clear the 64-token admission
+        # limit; the first run on a cold fleet otherwise measures XLA
+        # compiles, not serving, and poisons the knee's quiet baseline).
+        # Per replica DIRECTLY — router affinity must not decide which
+        # replica gets which compile — a burst dense enough to force
+        # every decode-batch shape (1..MAX_BATCH) and both prefill
+        # buckets before anything is measured:
+        for i, a in enumerate(replicas):
+            burst = synthesize(
+                poisson_arrivals(10.0, 5.0, random.Random(5)),
+                tenants=2, sessions=4, prompt_tokens=(1, 6),
+                max_new=(8, 16), seed=5)
+            OpenLoopRunner(f"http://127.0.0.1:{a.http_port}", burst,
+                           timeout_s=300.0,
+                           label=f"warm-r{i}").run(drain_timeout_s=300.0)
+        # then a short router-level pass (forwarding path, affinity)
+        warm = synthesize(
+            poisson_arrivals(rate_a, min(phase, 6.0), random.Random(5)),
+            tenants=4, sessions=8, prompt_tokens=(2, 6), max_new=(4, 8),
+            seed=5)
+        OpenLoopRunner(base, warm, timeout_s=300.0,
+                       label="warmup").run(drain_timeout_s=300.0)
+        # the capture ring must hold ONLY phase A (it is what phase B
+        # replays); the router object rides on app.fleet
+        router_app.fleet.capture.reset()
+
+        # ---- phase A: the "original" run ---------------------------------
+        events_a = synthesize(
+            poisson_arrivals(rate_a, phase, random.Random(11)),
+            tenants=4, sessions=8, session_reuse=0.6,
+            prompt_tokens=(2, 6), max_new=(4, 8), seed=11)
+        rows_a = OpenLoopRunner(base, events_a, timeout_s=300.0,
+                                label="orig").run(drain_timeout_s=300.0)
+        card_a = build_scorecard(rows_a)
+
+        # ---- capture: what the router observed ---------------------------
+        doc = _get_json(base + "/debug/trace")
+        captured = doc.get("events") or []
+        stats["captured"] = {"events": len(captured),
+                            "captured_total": doc.get("captured_total"),
+                            "offered": len(rows_a)}
+
+        # ---- phase B: replay the capture, compare scorecards -------------
+        rows_b = OpenLoopRunner(base, captured, timeout_s=300.0,
+                                label="replay").run(drain_timeout_s=300.0)
+        card_b = build_scorecard(rows_b)
+        comparison = compare(card_b, baseline_from_scorecard(card_a))
+        stats["scorecard"] = {
+            cls: {k: row.get(k) for k in (
+                "offered", "ok", "shed", "goodput", "ttft_ms_p50",
+                "ttft_ms_p95", "slo_met")}
+            for cls, row in card_a["classes"].items()}
+        stats["replay"] = {"verdict": comparison["verdict"],
+                           "checks": [c for c in comparison["checks"]
+                                      if c.get("verdict") != "pass"][:6]}
+
+        # ---- knee: λ-ramp vs the fleet capacity rollup, over sockets -----
+        quiet_ms = percentile(
+            [r["ttft_s"] * 1e3 for r in rows_a
+             if isinstance(r.get("ttft_s"), (int, float))], 50)
+        mu_hat = max(rate_a, len(rows_a) / phase)
+        # gentle slope on purpose: the queue must build over several λ
+        # windows so the forecaster has eval cycles to arm BEFORE the
+        # measured TTFT blows — a cliff-shaped ramp tests reflexes the
+        # fluid model never claimed to have; poll_s drives the collapse
+        # detector's eval cadence (the rollup GET fans out to every
+        # replica's evaluate()), so sample fast
+        flood_len = max(15.0, seconds / 2.0)
+        rate1 = 6.0 * mu_hat
+        knee = run_knee(
+            base, lambda: _get_json(base + "/debug/fleet/capacity",
+                                    timeout=5),
+            rate0_rps=max(1.0, 0.5 * mu_hat), rate1_rps=rate1,
+            seconds=flood_len, seed=13, poll_s=0.25,
+            drain_timeout_s=300.0, request_timeout_s=300.0,
+            baseline_ttft_ms=quiet_ms,
+            synth_kw={"tenants": 4, "prompt_tokens": (2, 6),
+                      "max_new": (4, 8)})
+        stats["knee"] = {k: knee[k] for k in (
+            "ramp", "baseline_ttft_ms", "blowout_ttft_ms",
+            "first_blowout_at_s", "collapse_warning_at_s", "peak_rho",
+            "replicas_needed_final", "agrees", "detail")}
+        hard = [r for r in rows_a + rows_b + knee["rows"]
+                if r.get("status") not in ("ok", "shed", "dropped")]
+        stats["hard_errors"] = len(hard)
+        if hard:
+            stats["error_samples"] = [
+                f"{r.get('status')}: {r.get('error')}" for r in hard[:8]]
+    finally:
+        router_app.shutdown()
+        for app in replicas:
+            app.shutdown()
+    stats["seconds"] = round(time.time() - t0, 1)
+    ok = (stats.get("hard_errors", 1) == 0
+          and card_a["offered"] > 0
+          and len(captured) >= int(0.9 * len(rows_a))
+          and comparison["verdict"] != "regress"
+          and knee["agrees"])
+    stats["verdict"] = ("pass" if ok else "regress")
+    stats["pass"] = ok
+    print(json.dumps(stats))
+    return ok
+
+
 def main() -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("profile", nargs="?", default="all",
                         choices=["mixed", "paged-int8", "spec", "chat",
                                  "disagg", "router", "multihost", "qos",
-                                 "capacity", "elastic", "all"])
+                                 "capacity", "elastic", "loadgen", "all"])
     parser.add_argument("--seconds", type=float, default=120.0)
     parser.add_argument("--threads", type=int, default=4)
     parser.add_argument("--chaos", action="store_true",
@@ -1781,7 +1974,7 @@ def main() -> int:
     preset = os.environ.get("SOAK_PRESET", "debug")
 
     profiles = (["mixed", "paged-int8", "spec", "chat", "disagg", "router",
-                 "qos", "capacity", "elastic", "multihost"]
+                 "qos", "capacity", "elastic", "loadgen", "multihost"]
                 if args.profile == "all" else [args.profile])
     results = []
     for p in profiles:
@@ -1795,6 +1988,8 @@ def main() -> int:
             results.append(run_capacity(args.seconds, args.threads, preset))
         elif p == "elastic":
             results.append(run_elastic(args.seconds, args.threads, preset))
+        elif p == "loadgen":
+            results.append(run_loadgen(args.seconds, args.threads, preset))
         elif p == "multihost":
             # under `all`, cap the two-process tier so it doesn't dominate
             # the sequence's wall time (the plane's invariants saturate
