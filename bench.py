@@ -1044,7 +1044,7 @@ def main() -> None:
         wall = summary["wall_s"]
         shares = {seg: round(s / wall, 4)
                   for seg, s in summary["segments"].items()}
-        return {"step_segments": {
+        segs = {
             "steps": summary["steps"],
             "wall_s": round(wall, 3),
             "shares": shares,
@@ -1052,7 +1052,24 @@ def main() -> None:
             "loop_host_share": round(sum(
                 shares.get(k, 0.0)
                 for k in ("device_sync", "demux", "emit", "host_prep")), 4),
-        }}
+        }
+        # WHICH code the host share is: the sampling profiler's top
+        # loop-thread stack (tpu/hostprof.py), leaf-most frames — the
+        # attribution next to the number, in the same artifact
+        try:
+            prof = getattr(eng, "hostprof", None)
+            top = prof.top_loop_stacks(1) if prof is not None else []
+            if top:
+                segs["loop_top_stack"] = {
+                    "frames": top[0]["stack"].split(";")[-4:],
+                    "samples": top[0]["samples"],
+                    "loop_samples": prof.snapshot()["threads"]["loop"][
+                        "samples"],
+                    "overhead_share": prof.snapshot()["overhead"]["share"],
+                }
+        except Exception:  # noqa: BLE001 — diagnostics never fail the bench
+            pass
+        return {"step_segments": segs}
 
     def make_engine(slots, seq, use_cfg, cls=LLMEngine, **extra):
         # block/depth from a sweep on v5e: small blocks turn finished slots
@@ -1118,6 +1135,14 @@ def main() -> None:
         return run_phase_throughput(eng, short_prompts, max_new,
                                     rounds=2 if full_run else 1)
 
+    # host sampling profiler rides T0 so the artifact says WHICH frames
+    # the loop_host_share was (stopped right after the phase; its
+    # measured self-overhead lands in the loop_top_stack extra)
+    from gofr_tpu.tpu.hostprof import HostProfiler
+
+    t0_hostprof = HostProfiler(hz=50.0)
+    engine.hostprof = t0_hostprof
+    t0_hostprof.start()
     t0_retry = False
     try:
         tok_s, tokens, elapsed, t0_ttfts = phase_t0(engine)
@@ -1136,6 +1161,7 @@ def main() -> None:
         record.rename_slots(n_slots)
         record.update(t0_oom_degraded_to_slots=n_slots)
         engine = make_engine(n_slots, max_seq, cfg)
+        engine.hostprof = t0_hostprof  # the retry engine's loop resamples
         tok_s, tokens, elapsed, t0_ttfts = phase_t0(engine)
     print(f"[bench] T0 short-prompt decode: {tokens} tok in {elapsed:.2f}s = "
           f"{tok_s:.1f} tok/s t={_spent():.0f}s", file=sys.stderr)
@@ -1143,6 +1169,7 @@ def main() -> None:
     # actually ran at (it grows during T0 to cover prompt + max_new +
     # pipeline margin)
     roofline_tok_s = _roofline_tok_s(cfg, engine) if on_tpu else 0.0
+    t0_hostprof.stop()
     record.update(value=tok_s,
                   t0_elapsed_s=round(elapsed, 2),
                   slots=engine.n_slots,

@@ -485,6 +485,18 @@ def build_app(config=None, engine=None) -> App:
     # STEP_BASELINE_* tune the ring and sentinel
     if app.config.get_bool("STEP_LEDGER", True):
         app.enable_step_ledger(engine)
+    # performance timeline: GET /debug/timeline renders the ledgers and
+    # recorders above as one Perfetto-loadable trace (real threads as
+    # named tracks, device busy slices, per-request flow arrows);
+    # TIMELINE=false opts out, TIMELINE_STEPS sets the step window
+    if app.config.get_bool("TIMELINE", True):
+        app.enable_timeline(engine)
+    # always-on host sampling profiler: GET /debug/hostprof attributes
+    # loop host time to Python frames (bounded collapsed stacks, measured
+    # self-overhead); HOSTPROF=false or HOSTPROF_HZ<=0 opts out,
+    # HOSTPROF_HZ / HOSTPROF_MAX_STACKS / HOSTPROF_TOP_K tune it
+    if app.config.get_bool("HOSTPROF", True):
+        app.enable_hostprof(engine)
     # incident autopsy plane: SLO burn-rate engine (GET /debug/slo,
     # app_tpu_slo_burn_rate / app_tpu_slo_alert_state) + anomaly-triggered
     # evidence bundles (GET /debug/incidents); fed by the flight recorder,

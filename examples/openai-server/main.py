@@ -94,6 +94,14 @@ def build_app(**kw) -> App:
     # parity; STEP_LEDGER=false opts out)
     if app.config.get_bool("STEP_LEDGER", True):
         app.enable_step_ledger(engine)
+    # Perfetto trace export at GET /debug/timeline (llm-server parity;
+    # TIMELINE=false opts out, TIMELINE_STEPS sets the window)
+    if app.config.get_bool("TIMELINE", True):
+        app.enable_timeline(engine)
+    # host sampling profiler at GET /debug/hostprof (llm-server parity;
+    # HOSTPROF=false or HOSTPROF_HZ<=0 opts out)
+    if app.config.get_bool("HOSTPROF", True):
+        app.enable_hostprof(engine)
     # incident autopsy plane: GET /debug/slo + /debug/incidents (llm-server
     # parity; INCIDENT_AUTOPSY=false opts out, SLO_BURN_*/INCIDENT_* tune)
     if app.config.get_bool("INCIDENT_AUTOPSY", True):

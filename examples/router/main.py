@@ -68,6 +68,18 @@ def build_app(config=None) -> App:
             capacity=app.config.get_int("FLEET_JOURNEY_CAPACITY", 256),
             metrics=metrics)
         install_journey_routes(app, router)
+        # stitched performance timeline: the journey's hop replicas'
+        # /debug/timeline windows clock-aligned into ONE multi-process
+        # Perfetto trace at GET /debug/fleet/timeline/{id}
+        # (FLEET_TIMELINE=false opts out; rides on the journey plane)
+        if app.config.get_bool("FLEET_TIMELINE", True):
+            from gofr_tpu.fleet.timeline import (
+                install_routes as install_fleet_timeline_routes,
+                register_fleet_timeline_metrics)
+
+            if metrics is not None:
+                register_fleet_timeline_metrics(metrics)
+            install_fleet_timeline_routes(app, router)
     # fleet SLO rollup: router-observed burn windows + per-replica
     # /debug/slo merge at GET /debug/fleet/slo, with a router-owned
     # IncidentManager that captures fleet_burn_hidden bundles when fleet

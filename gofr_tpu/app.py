@@ -241,10 +241,77 @@ class App:
         self.router.use_middleware(mw.oauth_jwks_middleware(keyset))
 
     def enable_profiler(self, path: str = "/debug/profile") -> None:
-        """Expose on-demand xprof device-trace capture (tpu/profiler.py)."""
-        from .tpu.profiler import install_routes
+        """Expose on-demand xprof device-trace capture (tpu/profiler.py).
 
+        Config: PROFILE_DIR (capture root for POSTs without "dir" and
+        incident-autopsy captures, default ./profiles); status() reports
+        trace paths relative to it, so "where did my trace go" doesn't
+        depend on the server's cwd."""
+        from .tpu.profiler import configure, install_routes
+
+        configure(self.config.get_or_default("PROFILE_DIR", "./profiles"))
         install_routes(self, path)
+
+    def enable_timeline(self, engine, path: str = "/debug/timeline"):
+        """Expose the Perfetto trace-event export (tpu/timeline.py):
+        GET /debug/timeline[?steps=N] renders the step ledger, flight
+        recorder, utilization ledger, and live compile events as one
+        chrome://tracing / ui.perfetto.dev-loadable JSON payload — real
+        threads as named tracks, device busy slices on an async track,
+        per-request flow arrows from enqueued to finished. A DISAGG
+        both engine contributes its prefill half under its own thread
+        block, so the hand-off is visible in one load.
+
+        Config: TIMELINE_STEPS (default step window, 128). Returns the
+        TimelineExporter (also attached as engine.timeline for the
+        fleet stitcher and soak gates)."""
+        from .tpu.timeline import (TimelineExporter, install_routes,
+                                   register_timeline_metrics)
+
+        metrics = self.container.metrics_manager
+        if metrics is not None:
+            register_timeline_metrics(metrics)
+        exporter = TimelineExporter(
+            engine, process_name=self.container.app_name,
+            max_steps=self.config.get_int("TIMELINE_STEPS", 128),
+            metrics=metrics)
+        engine.timeline = exporter
+        install_routes(self, exporter, path)
+        return exporter
+
+    def enable_hostprof(self, engine=None, path: str = "/debug/hostprof"):
+        """Start the always-on host sampling profiler (tpu/hostprof.py)
+        and expose GET /debug/hostprof: bounded collapsed-stack
+        aggregation over sys._current_frames(), classified per thread
+        (engine loop / finisher / http / other), with the sampler's
+        measured self-overhead in its own payload. Stopped via
+        on_shutdown, like the memory sampler.
+
+        Config: HOSTPROF_HZ (sampling rate, default 50; <= 0 disables
+        and returns None), HOSTPROF_MAX_STACKS (distinct stacks kept per
+        class, 256), HOSTPROF_TOP_K (stacks shown per class, 5). Returns
+        the HostProfiler (also attached as engine.hostprof so incident
+        bundles can embed the loop's top stacks)."""
+        from .tpu.hostprof import (HostProfiler, install_routes,
+                                   register_hostprof_metrics)
+
+        hz = self.config.get_float("HOSTPROF_HZ", 50.0)
+        if hz <= 0:
+            return None
+        metrics = self.container.metrics_manager
+        if metrics is not None:
+            register_hostprof_metrics(metrics)
+        prof = HostProfiler(
+            hz=hz,
+            max_stacks=self.config.get_int("HOSTPROF_MAX_STACKS", 256),
+            top_k=self.config.get_int("HOSTPROF_TOP_K", 5),
+            metrics=metrics, logger=self.logger)
+        prof.start()
+        self.on_shutdown(lambda: prof.stop())
+        if engine is not None:
+            engine.hostprof = prof
+        install_routes(self, prof, path)
+        return prof
 
     def enable_flight_recorder(self, engine, path: str = "/debug/requests"):
         """Attach a per-request flight recorder to `engine` and expose its
@@ -462,6 +529,13 @@ class App:
             max_per_hour=cfg.get_int("INCIDENT_MAX_PER_HOUR", 6),
             slowest_k=cfg.get_int("INCIDENT_SLOWEST_K", 5),
             profile_seconds=cfg.get_float("INCIDENT_PROFILE_S", 0.0),
+            # autopsy captures land under the profiler's configured root
+            # (PROFILE_DIR) when set, else beside the bundles
+            profile_dir=(cfg.get("PROFILE_DIR")
+                         or os.path.join(
+                             cfg.get_or_default("INCIDENT_DIR",
+                                                "./incidents"),
+                             "profiles")),
             straggler_streak=cfg.get_int("INCIDENT_STRAGGLER_STREAK", 3),
             straggler_window=cfg.get_int("INCIDENT_STRAGGLER_WINDOW", 32),
             fingerprint={"app": self.container.app_name,

@@ -468,6 +468,27 @@ class FlightRecorder:
                 "finished_total": self.finished_total,
             }
 
+    def timeline_records(self) -> List[Dict[str, Any]]:
+        """Request milestones in the RAW monotonic domain (no wall
+        rendering), completed then live, for the timeline exporter's flow
+        events (tpu/timeline.py). detail()/summary() render epochs for
+        humans; trace-event ``ts`` stays monotonic so one payload-level
+        anchor aligns everything at the stitching boundary."""
+        with self._lock:
+            recs = list(self._done) + sorted(self._live.values(),
+                                             key=lambda r: r.enqueued_at)
+            return [{
+                "id": r.id,
+                "trace_id": r.trace_id,
+                "enqueued_at": r.enqueued_at,
+                "admitted_at": r.admitted_at,
+                "first_token_at": r.first_token_at,
+                "finished_at": r.finished_at,
+                "generated": r.generated,
+                "outcome": r.outcome,
+                "handoff": r.handoff,
+            } for r in recs]
+
     def lookup(self, request_id: int) -> Optional[Dict[str, Any]]:
         with self._lock:
             rec = self._live.get(request_id)

@@ -381,6 +381,7 @@ class IncidentManager:
                  capacity: int = 32, cooldown_s: float = 300.0,
                  max_per_hour: int = 6, slowest_k: int = 5,
                  profile_seconds: float = 0.0,
+                 profile_dir: Optional[str] = None,
                  straggler_streak: int = 3, straggler_window: int = 32,
                  fingerprint: Optional[Dict[str, Any]] = None,
                  metrics=None, logger=None, clock=time.monotonic):
@@ -392,6 +393,9 @@ class IncidentManager:
         self.max_per_hour = max(1, int(max_per_hour))
         self.slowest_k = max(1, int(slowest_k))
         self.profile_seconds = float(profile_seconds)
+        # None -> autopsy captures land beside the bundles (dir/profiles);
+        # App.enable_incident_autopsy overrides with PROFILE_DIR when set
+        self.profile_dir = profile_dir or os.path.join(dir, "profiles")
         self.straggler_streak = max(1, int(straggler_streak))
         self.straggler_window = max(self.straggler_streak,
                                     int(straggler_window))
@@ -545,6 +549,15 @@ class IncidentManager:
                     bundle["slowest_request_id"] = slowest[0].get("id")
         except Exception as exc:  # noqa: BLE001
             bundle["recorder_error"] = str(exc)
+        try:
+            # what WAS the engine loop doing: the host sampling
+            # profiler's top loop-thread stacks (tpu/hostprof.py), read
+            # at capture time so enable order doesn't matter
+            prof = getattr(engine, "hostprof", None)
+            if prof is not None:
+                bundle["loop_stacks"] = prof.top_loop_stacks()
+        except Exception as exc:  # noqa: BLE001
+            bundle["hostprof_error"] = str(exc)
         bundle["config_fingerprint"] = self.config_fingerprint()
         bundle["profile"] = self._maybe_profile(incident_id)
         path = None
@@ -602,8 +615,7 @@ class IncidentManager:
             from . import profiler
 
             trace_dir, seconds = profiler.start_capture(
-                self.profile_seconds,
-                os.path.join(self.dir, "profiles"),
+                self.profile_seconds, self.profile_dir,
                 trigger="incident")
             return {"trace_dir": trace_dir, "seconds": seconds,
                     "status": "capturing"}

@@ -31,8 +31,15 @@ import time
 from typing import Optional, Tuple
 
 _MAX_SECONDS = 60.0
+_DEFAULT_DIR = "./profiles"
 
 _lock = threading.Lock()
+# the process-wide capture root (PROFILE_DIR): App.enable_profiler sets it
+# once at boot via configure(); every caller that doesn't name a dir —
+# POST /debug/profile without "dir", incident autopsy captures — lands
+# here, and status() reports paths RELATIVE to it so the answer to
+# "where did my trace go" doesn't depend on the server's cwd
+_profile_dir = _DEFAULT_DIR
 _state = {"active": False, "pending_dir": None, "started_at": None,
           "last_dir": None, "last_captured_at": None, "last_error": None,
           # capture provenance: who asked ("manual" POST vs. "incident"
@@ -41,6 +48,35 @@ _state = {"active": False, "pending_dir": None, "started_at": None,
           # step can't fake a wedged or instant capture
           "trigger": None, "seconds": None, "started_mono": None,
           "last_trigger": None, "last_duration_s": None}
+
+
+def configure(profile_dir: Optional[str]) -> str:
+    """Set the process-wide capture root (App.enable_profiler reads it
+    from PROFILE_DIR). Returns the effective dir; None/"" keeps the
+    current one."""
+    global _profile_dir
+    with _lock:
+        if profile_dir:
+            _profile_dir = str(profile_dir)
+        return _profile_dir
+
+
+def profile_dir() -> str:
+    """The effective capture root (for status surfaces and tests)."""
+    with _lock:
+        return _profile_dir
+
+
+def _rel(path: Optional[str], root: str) -> Optional[str]:
+    """`path` relative to the capture root when it lives under it —
+    the operator-facing spelling ("trace-.../" not "/pod/cwd/...")."""
+    if not path:
+        return None
+    try:
+        rel = os.path.relpath(path, root)
+    except ValueError:  # different drive (windows) — keep the absolute
+        return path
+    return path if rel.startswith("..") else rel
 
 
 def _run_capture(seconds: float, out: str) -> None:
@@ -71,18 +107,22 @@ def _run_capture(seconds: float, out: str) -> None:
             _state["last_captured_at"] = time.time()  # lint: clock-ok operator-facing wall-clock timestamp in status()
 
 
-def start_capture(seconds: float, log_dir: str = "./profiles",
+def start_capture(seconds: float, log_dir: Optional[str] = None,
                   trigger: str = "manual") -> Tuple[str, float]:
     """Begin an async capture; returns (trace_dir, bounded_seconds).
 
-    `trigger` records provenance in status(): "manual" for the POST
-    /debug/profile operator path, "incident" for autopsy-plane captures
+    `log_dir=None` (the default) captures under the configured
+    PROFILE_DIR root — callers only name a dir to override it. `trigger`
+    records provenance in status(): "manual" for the POST /debug/profile
+    operator path, "incident" for autopsy-plane captures
     (tpu/incidents.py). Raises ValueError on a bad duration and
     RuntimeError while another capture runs (the profiler is a global
     singleton in the process) — the HTTP route maps that to 409."""
     seconds = min(float(seconds), _MAX_SECONDS)
     if seconds <= 0:
         raise ValueError("profile duration must be positive")
+    if not log_dir:
+        log_dir = profile_dir()
     out = os.path.join(log_dir, time.strftime("trace-%Y%m%d-%H%M%S"))
     with _lock:
         if _state["active"]:
@@ -107,7 +147,7 @@ def start_capture(seconds: float, log_dir: str = "./profiles",
     return out, seconds
 
 
-def capture_trace(seconds: float, log_dir: str = "./profiles",
+def capture_trace(seconds: float, log_dir: Optional[str] = None,
                   poll_s: float = 0.05) -> str:
     """Blocking convenience wrapper around start_capture (scripts/tools):
     waits for the capture to finish and returns its trace dir."""
@@ -126,10 +166,16 @@ def capture_trace(seconds: float, log_dir: str = "./profiles",
 def status() -> dict:
     with _lock:
         out = dict(_state)
+        root = _profile_dir
         if out["started_mono"] is not None:
             out["running_for_s"] = round(
                 time.monotonic() - out["started_mono"], 3)
         del out["started_mono"]  # internal clock; epochs stay for display
+    out["profile_dir"] = root
+    # operator-facing relative spellings: "where did my trace go" must
+    # not depend on the server's cwd at boot
+    out["pending_rel"] = _rel(out.get("pending_dir"), root)
+    out["last_rel"] = _rel(out.get("last_dir"), root)
     return out
 
 
@@ -141,7 +187,8 @@ def install_routes(app, path: str = "/debug/profile") -> None:
     def profile(ctx):  # noqa: ANN001
         body = ctx.bind() or {}
         seconds = float(body.get("seconds", 2.0))
-        log_dir = str(body.get("dir", "./profiles"))
+        # no "dir" in the body -> the configured PROFILE_DIR root
+        log_dir = str(body["dir"]) if body.get("dir") else None
         try:
             trace_dir, bounded = start_capture(seconds, log_dir,
                                                trigger="manual")

@@ -456,6 +456,15 @@ class StepLedger:
                     pass
 
     # -- operator surface -----------------------------------------------------
+    def records(self, recent: int = 64) -> List[StepRecord]:
+        """The newest `recent` StepRecords, oldest first. Records are
+        immutable after _finish, so handing out the refs is safe — the
+        timeline exporter (tpu/timeline.py) needs `started_at`, which
+        summary() omits (it is a monotonic stamp, meaningless to a
+        human reading /debug/steps)."""
+        with self._lock:
+            return list(self._ring)[-max(1, int(recent)):]
+
     def snapshot(self, recent: int = 64) -> Dict[str, Any]:
         """The /debug/steps payload: recent ring (newest first), per-phase
         segment totals over the whole ring, live baselines, stragglers."""

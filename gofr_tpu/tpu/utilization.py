@@ -246,6 +246,19 @@ class UtilizationLedger:
         while self._host and self._host[0][0] < cutoff:
             self._host.popleft()
 
+    def device_slices(self) -> List[Dict[str, Any]]:
+        """The window's dispatch→sync busy intervals as drawable slices,
+        oldest first, for the timeline exporter's async device track
+        (tpu/timeline.py). The busy-union watermark already made the
+        intervals non-overlapping: each entry's busy time starts where
+        the previous sync (or its own dispatch) ended."""
+        with self._lock:
+            entries = list(self._entries)
+        return [{"start": synced - busy, "end": synced, "phase": phase,
+                 "tokens": toks, "busy_s": busy, "sync_wait_s": wait}
+                for synced, phase, _flops, _nbytes, busy, wait, toks
+                in entries if busy > 0.0]
+
     # -- rolling window read-out ----------------------------------------------
     def window_stats(self, now: Optional[float] = None) -> Dict[str, Any]:
         now = now if now is not None else time.monotonic()

@@ -342,3 +342,41 @@ def test_profiler_busy_answers_409(tmp_path):
         assert status["active"] is False  # leave the singleton idle
     finally:
         app.shutdown()
+
+
+def test_profiler_captures_land_under_configured_profile_dir(tmp_path):
+    """PROFILE_DIR is the process-wide capture root: a POST without an
+    explicit dir writes under it, and status() reports paths relative to
+    it (the regression: captures used to land relative to whatever cwd
+    the process happened to start in)."""
+    import os
+    import time as _time
+
+    from gofr_tpu.tpu import profiler as profmod
+
+    root = str(tmp_path / "prof-root")
+    app = make_app({"PROFILE_DIR": root})
+    app.enable_profiler()
+    try:
+        assert profmod.profile_dir() == root
+        app.start()
+        base = f"http://127.0.0.1:{app.http_port}"
+        r = requests.post(f"{base}/debug/profile", json={"seconds": 0.5})
+        assert r.status_code == 202
+        trace_dir = r.json()["data"]["trace_dir"]
+        assert trace_dir.startswith(root)
+        deadline = _time.time() + 30
+        while _time.time() < deadline:
+            status = requests.get(f"{base}/debug/profile").json()["data"]
+            if not status["active"]:
+                break
+            _time.sleep(0.05)
+        assert status["active"] is False
+        assert status["profile_dir"] == root
+        assert status["last_dir"] == trace_dir
+        # the operator-facing relative form never escapes the root
+        assert status["last_rel"] == os.path.relpath(trace_dir, root)
+        assert not status["last_rel"].startswith("..")
+    finally:
+        app.shutdown()
+        profmod.configure(profmod._DEFAULT_DIR)  # leave the global clean

@@ -194,6 +194,39 @@ def test_render_loadgen_degrades():
     assert "loadgen" not in grafttop.render({"t": 0})
 
 
+def test_render_hostprof_panel():
+    """Per-replica /debug/hostprof digests render as one line each: loop
+    samples, sampler self-overhead, the leaf-most top loop frames."""
+    data = _payload()
+    data["replica_hostprof"] = {
+        "r0": {
+            "overhead": {"share": 0.0042},
+            "threads": {"loop": {
+                "samples": 812,
+                "top": [{"stack": "threading.Thread.run;"
+                                  "gofr_tpu.tpu.engine.LLMEngine._loop;"
+                                  "gofr_tpu.tpu.engine.LLMEngine._step;"
+                                  "jax._src.api.block_until_ready",
+                         "samples": 310}]}},
+        },
+        "r1": {"threads": {"loop": {"samples": 0, "top": []}}},
+    }
+    frame = grafttop.render(data)
+    assert "hostprof" in frame
+    assert "top loop stack" in frame
+    # leaf-most frames, leaf first, with the sample count
+    assert "block_until_ready<-_step<-_loop (310)" in frame
+    assert "812" in frame
+    assert "0.42%" in frame
+    # a replica with no loop samples renders a placeholder, not a crash
+    assert "\n  r1" in frame
+
+
+def test_render_without_hostprof_shows_no_panel():
+    frame = grafttop.render(_payload())
+    assert "hostprof" not in frame
+
+
 def test_bar_and_fmt_handle_non_numeric():
     assert grafttop._bar(None) == "-" * grafttop.BAR_WIDTH
     assert grafttop._bar(99.0, scale=1.0) == "#" * grafttop.BAR_WIDTH

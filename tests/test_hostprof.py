@@ -1,0 +1,248 @@
+"""Always-on host sampling profiler: thread classification, bounded
+collapsed-stack aggregation, the measured-self-overhead honesty gate,
+/debug/hostprof, and the incident-bundle loop-stack embed.
+
+ISSUE 20's acceptance surface: the sampler's measured self-overhead
+stays under 2% of loop wall-clock at the default 50 Hz during a real
+engine run; an incident bundle captured during a fault-injected stall
+contains non-empty loop stacks naming what the loop was doing.
+"""
+
+import re
+import threading
+import time
+
+import pytest
+
+from gofr_tpu.metrics import Manager
+from gofr_tpu.models.llama import LlamaConfig, llama_init
+from gofr_tpu.tpu.hostprof import (CLASSES, HostProfiler,
+                                   register_hostprof_metrics)
+from gofr_tpu.tpu.ownership import LOOP_ONLY_REGISTRY
+
+pytestmark = pytest.mark.timeline
+
+CFG = LlamaConfig.debug()
+
+
+def _engine(**kw):
+    from gofr_tpu.tpu.engine import LLMEngine
+
+    kw.setdefault("n_slots", 2)
+    kw.setdefault("max_seq_len", 128)
+    kw.setdefault("prefill_buckets", (16,))
+    kw.setdefault("decode_block_size", 1)
+    kw.setdefault("pipeline_depth", 1)
+    return LLMEngine(llama_init(CFG, seed=0), CFG, **kw)
+
+
+def _park(name, depth, ready, release):
+    """A thread parked at a known recursion depth — a deterministic
+    distinct collapsed stack for the sampler to fold."""
+
+    def nest(n):
+        if n > 0:
+            nest(n - 1)
+        else:
+            ready.append(name)
+            release.wait(30.0)
+
+    t = threading.Thread(target=nest, args=(depth,), name=name,
+                         daemon=True)
+    t.start()
+    return t
+
+
+# -- classification -----------------------------------------------------------
+def test_classification_by_thread_name_and_registry_fallback():
+    prof = HostProfiler()
+    assert prof._classify("llm-engine", []) == "loop"
+    assert prof._classify("llm-finisher", []) == "finisher"
+    assert prof._classify("http-server-3", []) == "http"
+    assert prof._classify("Thread-7", []) == "http"
+    assert prof._classify("grpc-worker", []) == "http"
+    assert prof._classify("whatever", ["mod.fn"]) == "other"
+    # a renamed/embedded engine loop is still recognized by the
+    # @loop_only functions on its stack (the ownership registry — which
+    # populates when the decorated engine module imports)
+    import gofr_tpu.tpu.engine  # noqa: F401
+
+    pinned = sorted(LOOP_ONLY_REGISTRY)[0]
+    assert prof._classify("renamed", ["a.b", pinned, "c.d"]) == "loop"
+
+
+def test_sample_once_folds_parked_threads_and_skips_itself():
+    ready, release = [], threading.Event()
+    threads = [_park("llm-engine", 3, ready, release),
+               _park("parked-other", 5, ready, release)]
+    try:
+        deadline = time.monotonic() + 10.0
+        while len(ready) < 2:
+            assert time.monotonic() < deadline, "park threads never parked"
+            time.sleep(0.005)
+        prof = HostProfiler()
+        prof.sample_once()
+        snap = prof.snapshot()
+        assert snap["threads"]["loop"]["samples"] >= 1
+        assert snap["threads"]["other"]["samples"] >= 1
+        top = snap["threads"]["loop"]["top"]
+        assert top and "nest" in top[0]["stack"]
+        # root-first collapsed convention: the thread bootstrap is the
+        # root, the parked leaf (Event.wait) is last
+        frames = top[0]["stack"].split(";")
+        assert len(frames) >= 4
+        assert "wait" in frames[-1]
+        # the sampler never profiles the thread doing the sampling
+        for cls in CLASSES:
+            for entry in prof.snapshot(top_k=64)["threads"][cls]["top"]:
+                assert "sample_once" not in entry["stack"]
+    finally:
+        release.set()
+        for t in threads:
+            t.join(timeout=5.0)
+
+
+def test_stack_table_is_bounded_and_overflow_is_counted():
+    ready, release = [], threading.Event()
+    threads = [_park(f"parked-{i}", i + 1, ready, release)
+               for i in range(12)]
+    try:
+        deadline = time.monotonic() + 10.0
+        while len(ready) < 12:
+            assert time.monotonic() < deadline, "park threads never parked"
+            time.sleep(0.005)
+        prof = HostProfiler(max_stacks=8)
+        prof.sample_once()
+        other = prof.snapshot(top_k=64)["threads"]["other"]
+        # 12 distinct recursion depths cannot all fit in 8 buckets
+        assert other["distinct_stacks"] <= 8
+        assert other["dropped_stacks"] >= 1
+        assert other["samples"] >= 12
+    finally:
+        release.set()
+        for t in threads:
+            t.join(timeout=5.0)
+
+
+def test_collapsed_text_is_flamegraph_format():
+    ready, release = [], threading.Event()
+    t = _park("llm-engine", 2, ready, release)
+    try:
+        deadline = time.monotonic() + 10.0
+        while not ready:
+            assert time.monotonic() < deadline
+            time.sleep(0.005)
+        prof = HostProfiler()
+        prof.sample_once()
+        text = prof.collapsed()
+        assert text.endswith("\n")
+        for line in text.splitlines():
+            assert re.match(r"^(loop|finisher|http|other);\S.* \d+$",
+                            line), line
+    finally:
+        release.set()
+        t.join(timeout=5.0)
+
+
+def test_metrics_registration_is_idempotent_and_samples_count():
+    m = Manager()
+    register_hostprof_metrics(m)
+    register_hostprof_metrics(m)  # second call is a no-op, not an error
+    assert m.get("app_tpu_hostprof_samples_total") is not None
+    assert m.get("app_tpu_hostprof_overhead_share") is not None
+    prof = HostProfiler(metrics=m)
+    prof.sample_once()
+    prof.sample_once()
+    assert prof.samples_total == 2
+    exposition = m.expose()
+    assert "app_tpu_hostprof_samples_total 2" in exposition
+    prof.snapshot()  # publishes the overhead gauge
+    assert "app_tpu_hostprof_overhead_share" in m.expose()
+
+
+def test_duty_cycle_governor_stretches_interval_under_expensive_samples():
+    """The always-on bound is enforced, not hoped for: when a sample
+    gets expensive (many live threads, contended GIL) the governor
+    stretches the sleep so steady-state cost/interval == budget."""
+    prof = HostProfiler(hz=50.0)
+    # cheap samples: the configured rate stands
+    prof._cost_ema = 0.0001
+    assert prof._next_interval() == pytest.approx(prof.interval_s)
+    # a 5 ms sample at a 1% budget forces a 500 ms cadence
+    prof._cost_ema = 0.005
+    wait = prof._next_interval()
+    assert wait == pytest.approx(0.005 / prof.overhead_budget)
+    assert wait > prof.interval_s
+    snap = prof.snapshot()
+    assert snap["overhead"]["throttled"] >= 1
+    assert snap["overhead"]["interval_s"] == pytest.approx(wait)
+    assert snap["overhead"]["budget"] == prof.overhead_budget
+    # the EMA tracks real sample cost
+    prof._cost_ema = 0.0
+    prof.sample_once()
+    assert prof._cost_ema > 0.0
+
+
+# -- acceptance: self-overhead under a real engine run ------------------------
+def test_overhead_share_under_two_percent_of_loop_wall():
+    """The always-on claim, measured by the profiler itself: sampling at
+    the default 50 Hz through a real engine generation costs < 2% of the
+    wall-clock the loop ran."""
+    eng = _engine()
+    prof = HostProfiler(hz=50.0)
+    eng.hostprof = prof
+    prof.start()
+    eng.start()
+    try:
+        request = eng.submit([1, 2, 3], max_new_tokens=24)
+        tokens = request.result(timeout_s=120)
+        assert len(tokens) == 24
+    finally:
+        eng.stop()
+        prof.stop()
+    snap = prof.snapshot()
+    assert snap["samples_total"] >= 1
+    assert snap["threads"]["loop"]["samples"] >= 1, (
+        "the engine loop was never sampled")
+    assert snap["overhead"]["self_s"] >= 0.0
+    assert snap["overhead"]["share"] < 0.02, snap["overhead"]
+
+
+# -- acceptance: incident bundles name what the loop was doing ----------------
+def test_incident_bundle_during_stall_embeds_loop_stacks(tmp_path):
+    """A fault-injected engine.sync stall: the incident captured while
+    the loop sits in the stall embeds the profiler's top loop stacks —
+    the bundle answers "what WAS the loop doing" offline."""
+    from gofr_tpu.tpu.faults import FaultPlane
+    from gofr_tpu.tpu.incidents import IncidentManager
+
+    eng = _engine()
+    prof = HostProfiler(hz=100.0)
+    eng.hostprof = prof
+    eng.faults = FaultPlane(plan=[{"site": "engine.sync",
+                                   "action": "delay", "delay_s": 0.6,
+                                   "nth": 8}], seed=3)
+    inc = IncidentManager(engine=eng, dir=str(tmp_path), cooldown_s=0.0)
+    prof.start()
+    eng.start()
+    try:
+        request = eng.submit([1, 2, 3], max_new_tokens=20)
+        # trigger mid-run, once the sampler has seen the loop working
+        deadline = time.monotonic() + 60.0
+        while prof.snapshot()["threads"]["loop"]["samples"] < 3:
+            assert time.monotonic() < deadline, "loop never sampled"
+            time.sleep(0.01)
+        incident_id = inc.trigger("straggler_streak", cause="device_sync")
+        assert incident_id is not None
+        tokens = request.result(timeout_s=120)
+        assert len(tokens) == 20
+    finally:
+        eng.stop()
+        prof.stop()
+    assert inc.wait_idle(30.0)
+    bundle = inc.lookup(incident_id)
+    assert bundle is not None
+    stacks = bundle.get("loop_stacks")
+    assert stacks, f"bundle carried no loop stacks: {sorted(bundle)}"
+    for entry in stacks:
+        assert entry["stack"] and entry["samples"] >= 1

@@ -496,7 +496,13 @@ def test_e2e_capture_replay_reproduces(live_fleet):
         drain_timeout_s=120.0)
     comparison = compare(build_scorecard(rows_b),
                          baseline_from_scorecard(build_scorecard(rows_a)))
-    assert comparison["verdict"] != "regress", comparison
+    # reproduction = no per-metric drift beyond the noise band. The
+    # absolute SLO objectives (slo_met) are a property of how loaded the
+    # box is, not of capture/replay fidelity — both runs share that fate,
+    # so they are excluded here.
+    drifted = [c for c in comparison["checks"]
+               if c.get("metric") != "slo_met" and c["verdict"] == "regress"]
+    assert not drifted, drifted
 
 
 def test_e2e_replica_trace_export(live_fleet):

@@ -321,20 +321,29 @@ def test_metric_inventory_consistency():
         "meter attribution counters vanished from the inventory scan"
     assert any(n.startswith("app_tpu_capacity_") for n in recorded), \
         "capacity forecast gauges vanished from the inventory scan"
+    # the performance-timeline families must be IN the scan (guards
+    # scanner rot against timeline.py / hostprof.py's MetricsHook style)
+    assert any(n.startswith("app_tpu_timeline_") for n in recorded), \
+        "timeline export counters vanished from the inventory scan"
+    assert any(n.startswith("app_tpu_hostprof_") for n in recorded), \
+        "hostprof sampler metrics vanished from the inventory scan"
 
     from gofr_tpu.fleet import (register_elastic_metrics,
                                 register_fleet_capacity_metrics,
                                 register_fleet_metrics,
                                 register_fleet_slo_metrics,
                                 register_journey_metrics)
+    from gofr_tpu.fleet.timeline import register_fleet_timeline_metrics
     from gofr_tpu.tpu.device import TPUClient
     from gofr_tpu.tpu.disagg import register_disagg_metrics
     from gofr_tpu.tpu.flightrecorder import register_slo_gauges
+    from gofr_tpu.tpu.hostprof import register_hostprof_metrics
     from gofr_tpu.tpu.incidents import register_incident_metrics
     from gofr_tpu.tpu.meter import register_meter_metrics
     from gofr_tpu.tpu.migrate import register_migration_metrics
     from gofr_tpu.tpu.qos import register_qos_metrics
     from gofr_tpu.tpu.stepledger import register_step_metrics
+    from gofr_tpu.tpu.timeline import register_timeline_metrics
 
     manager = Manager()
     client = TPUClient()
@@ -353,6 +362,9 @@ def test_metric_inventory_consistency():
     register_meter_metrics(manager)
     register_migration_metrics(manager)
     register_elastic_metrics(manager)
+    register_timeline_metrics(manager)
+    register_hostprof_metrics(manager)
+    register_fleet_timeline_metrics(manager)
     registered = set(manager._store)
     missing = recorded - registered
     assert not missing, (
@@ -389,7 +401,8 @@ def test_debug_endpoint_inventory_documented():
                      "/debug/steps", "/debug/faults", "/debug/slo",
                      "/debug/incidents", "/debug/disagg", "/debug/fleet",
                      "/debug/qos", "/debug/capacity",
-                     "/debug/fleet/capacity"):
+                     "/debug/fleet/capacity", "/debug/timeline",
+                     "/debug/hostprof", "/debug/fleet/timeline"):
         assert expected in routes, f"scan missed {expected} (scanner rot?)"
 
     docs = os.path.join(os.path.dirname(__file__), "..", "docs",

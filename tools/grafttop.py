@@ -9,7 +9,9 @@ last requests actually experience (recent journeys with attempts /
 TTFB / outcome). Everything comes from the operator surfaces the
 router and replicas already serve — `/debug/fleet`,
 `/debug/fleet/slo`, `/debug/fleet/capacity`, `/debug/fleet/elastic`,
-`/debug/journey`, and per-replica `/stats` + `/debug/qos` via the
+`/debug/journey`, and per-replica `/stats` + `/debug/qos` +
+`/debug/hostprof` (the top engine-loop stack per replica — WHAT the
+loop is doing next to how busy it is) via the
 addresses the fleet snapshot advertises — so
 grafttop needs no credentials, no agents, and nothing but stdlib.
 
@@ -75,6 +77,7 @@ def fetch(router: str, loadgen: str = "") -> dict:
     replicas = (out.get("fleet") or {}).get("replicas", [])
     stats: dict = {}
     qos: dict = {}
+    hostprof: dict = {}
     for row in replicas:
         name, addr = row.get("name"), row.get("address")
         if not name or not addr:
@@ -88,8 +91,13 @@ def fetch(router: str, loadgen: str = "") -> dict:
             qos[name] = _get_json(addr + "/debug/qos")
         except Exception:  # noqa: BLE001 - QOS=false replicas lack it
             pass
+        try:
+            hostprof[name] = _get_json(addr + "/debug/hostprof")
+        except Exception:  # noqa: BLE001 - HOSTPROF=false replicas lack it
+            pass
     out["replica_stats"] = stats
     out["replica_qos"] = qos
+    out["replica_hostprof"] = hostprof
     return out
 
 
@@ -228,6 +236,28 @@ def render(data: dict, color: bool = False, width: int = 0) -> str:
                          + ("!" if snap.get("collapse_warning") else ""))
         if marks:
             lines.append("  replica rho " + "  ".join(marks))
+
+    # -- hostprof: what each replica's engine loop is doing -----------------
+    profs = data.get("replica_hostprof") or {}
+    if profs:
+        lines.append("")
+        lines.append(f"  {'hostprof':10} {'loop':6} {'ovh':7} top loop stack")
+        for name in sorted(profs):
+            snap = profs[name] or {}
+            threads = snap.get("threads") or {}
+            loop = threads.get("loop") or {}
+            top = loop.get("top") or []
+            # leaf-most frames carry the signal; the module roots repeat
+            leaf = "-"
+            if top:
+                frames = (top[0].get("stack") or "").split(";")
+                leaf = ("<-".join(f.rsplit(".", 1)[-1]
+                                  for f in reversed(frames[-3:]))
+                        + f" ({top[0].get('samples', 0)})")
+            share = (snap.get("overhead") or {}).get("share")
+            ovh = f"{share * 100:.2f}%" if isinstance(share, float) else "-"
+            lines.append(f"  {name:10} {str(loop.get('samples', '-')):6} "
+                         f"{ovh:7} {leaf}")
 
     # -- elastic reconciler (ELASTIC=true routers) --------------------------
     if "elastic" in data:

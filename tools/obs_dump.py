@@ -42,10 +42,18 @@ side: offered vs served rps (the gap IS the backlog), per-class
 inflight, outcome counts, and the live scorecard verdict — so the
 timeline shows what was OFFERED next to what the server did with it.
 
+Every line also carries a `/debug/hostprof` digest (per-class sample
+counts, the top loop-thread stacks, and the sampler's measured
+self-overhead — WHAT the loop was doing next to how long it took), and
+with --timeline a `/debug/timeline` digest (event/flow counts + the
+clock anchor) proving the Perfetto export is alive; the full trace
+belongs in its own artifact (tools/soak.py archives TIMELINE_*.json).
+
 Usage:
     python tools/obs_dump.py [--server http://127.0.0.1:8000]
                              [--metrics http://127.0.0.1:2121]
                              [--loadgen http://127.0.0.1:9100]
+                             [--timeline [STEPS]]
                              [--interval 5] [--count 0]
                              [--out obs_dump.jsonl]
 
@@ -100,7 +108,7 @@ def scrape_gauges(metrics_base: str) -> dict:
 
 
 def poll_once(server: str, metrics_base: str,
-              loadgen_base: str = "") -> dict:
+              loadgen_base: str = "", timeline_steps: int = 0) -> dict:
     entry: dict = {"t": time.time()}
     try:
         body = json.loads(_get(server.rstrip("/") + "/debug/requests"))
@@ -354,6 +362,45 @@ def poll_once(server: str, metrics_base: str,
         except Exception as exc:  # noqa: BLE001 - generator may be gone
             entry["loadgen_error"] = str(exc)
     try:
+        body = json.loads(_get(server.rstrip("/") + "/debug/hostprof"))
+        snap = body.get("data", body)
+        threads = snap.get("threads") or {}
+        # top loop stack + per-class sample counts + the sampler's own
+        # measured overhead — "what was the loop doing" on every line
+        entry["hostprof"] = {
+            "samples_total": snap.get("samples_total"),
+            "overhead": snap.get("overhead"),
+            "classes": {cls: row.get("samples")
+                        for cls, row in threads.items()},
+            "loop_top": (threads.get("loop") or {}).get("top", [])[:3],
+        }
+    except Exception as exc:  # noqa: BLE001 - HOSTPROF=false servers lack it
+        entry["hostprof_error"] = str(exc)
+    if timeline_steps:
+        try:
+            body = json.loads(_get(
+                server.rstrip("/")
+                + f"/debug/timeline?steps={int(timeline_steps)}"))
+            snap = body.get("data", body)
+            events = snap.get("traceEvents", [])
+            phases: dict = {}
+            for ev in events:
+                ph = ev.get("ph", "?")
+                phases[ph] = phases.get(ph, 0) + 1
+            # digest only — the full trace belongs in its own artifact
+            # (tools/soak.py archives TIMELINE_*.json); the JSONL line
+            # carries enough to see the export is alive and flowing
+            entry["timeline"] = {
+                "events_total": snap.get("events_total", len(events)),
+                "steps_window": snap.get("steps_window"),
+                "phases": phases,
+                "flows": len({ev.get("id") for ev in events
+                              if ev.get("cat") == "flow"}),
+                "anchor": snap.get("anchor"),
+            }
+        except Exception as exc:  # noqa: BLE001 - TIMELINE=false servers lack it
+            entry["timeline_error"] = str(exc)
+    try:
         entry["gauges"] = scrape_gauges(metrics_base)
     except Exception as exc:  # noqa: BLE001
         entry["metrics_error"] = str(exc)
@@ -369,6 +416,11 @@ def main() -> int:
     ap.add_argument("--loadgen", default="",
                     help="loadgen StatusServer base (serves "
                          "/debug/loadgen); empty skips the panel")
+    ap.add_argument("--timeline", type=int, nargs="?", const=8, default=0,
+                    metavar="STEPS",
+                    help="also poll /debug/timeline and record a digest "
+                         "(event/flow counts over the last STEPS steps, "
+                         "default 8); 0 skips the panel")
     ap.add_argument("--interval", type=float, default=5.0)
     ap.add_argument("--count", type=int, default=0,
                     help="polls before exiting; 0 = until interrupted")
@@ -382,7 +434,8 @@ def main() -> int:
     try:
         while True:
             entry = poll_once(args.server, args.metrics,
-                              loadgen_base=args.loadgen)
+                              loadgen_base=args.loadgen,
+                              timeline_steps=args.timeline)
             fp.write(json.dumps(entry) + "\n")
             fp.flush()
             n += 1

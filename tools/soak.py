@@ -535,6 +535,66 @@ def run_disagg(seconds: float, n_threads: int, preset: str) -> bool:
     return ok
 
 
+def _timeline_audit(base: str, artifact: str, stats: dict,
+                    journeys: int = 6):
+    """Stitched-fleet-timeline audit shared by the router-tier soaks:
+    fetch recent journeys' /debug/fleet/timeline/{id} traces, gate flow
+    continuity (every request flow with an `s` must carry its terminal
+    `f` — run AFTER traffic drains, while the replicas still serve), and
+    archive the richest multi-process trace as `artifact` (Perfetto-
+    loadable as-is; CI uploads TIMELINE_*.json next to the SOAK
+    reports). Returns (checked, flows, breaks) and records the evidence
+    in `stats`."""
+    import urllib.request
+
+    checked, flows_total, breaks, best = 0, 0, [], None
+    try:
+        with urllib.request.urlopen(base + "/debug/journey",
+                                    timeout=10) as resp:
+            index = json.loads(resp.read().decode())["data"]
+        for row in index.get("recent", [])[:journeys]:
+            jid = row.get("id")
+            try:
+                with urllib.request.urlopen(
+                        base + f"/debug/fleet/timeline/{jid}",
+                        timeout=10) as resp:
+                    stitched = json.loads(resp.read().decode())["data"]
+            except Exception as exc:  # noqa: BLE001 - a break, not a crash
+                breaks.append({"id": jid, "error": str(exc)[:120]})
+                continue
+            checked += 1
+            flows: dict = {}
+            for ev in stitched.get("traceEvents", []):
+                if ev.get("cat") == "flow":
+                    flows.setdefault(ev.get("id"), set()).add(ev.get("ph"))
+            flows_total += len(flows)
+            for fid, phases in flows.items():
+                if "s" in phases and "f" not in phases:
+                    breaks.append({"id": jid, "flow": fid,
+                                   "phases": sorted(phases)})
+            if not stitched.get("complete"):
+                breaks.append({"id": jid,
+                               "missing": stitched.get("missing")})
+            if best is None or (stitched.get("events_total", 0)
+                                > best.get("events_total", 0)):
+                best = stitched
+    except Exception as exc:  # noqa: BLE001 - absence of the plane = fail
+        breaks.append({"error": str(exc)[:120]})
+    stats["timeline_checked"] = checked
+    stats["timeline_flows"] = flows_total
+    if breaks:
+        stats["timeline_flow_breaks"] = breaks[:8]
+    if best is not None:
+        try:
+            with open(artifact, "w", encoding="utf-8") as fp:
+                json.dump(best, fp)
+            stats["timeline_artifact"] = artifact
+            stats["timeline_events"] = best.get("events_total")
+        except Exception as exc:  # noqa: BLE001 - artifact loss is reported
+            stats["timeline_artifact_error"] = str(exc)[:120]
+    return checked, flows_total, breaks
+
+
 def run_router(seconds: float, n_threads: int, preset: str) -> bool:
     """Fleet front-door soak (gofr_tpu/fleet): two in-process llm-server
     replicas behind the REAL examples/router app, multi-turn session
@@ -550,7 +610,11 @@ def run_router(seconds: float, n_threads: int, preset: str) -> bool:
     journey must assemble into a cross-hop waterfall with ZERO orphan
     hops (no missing replica payloads) even though one replica spent
     the middle of the run breaker-open; the worst end-to-end waterfall
-    rides in the report."""
+    rides in the report. The stitched fleet performance timeline gates
+    too: recent journeys' multi-process Perfetto traces must carry ZERO
+    request flows missing their terminal (an `s` without its `f` is a
+    request the timeline lost), and the richest one is archived as
+    TIMELINE_router.json — CI uploads it next to the SOAK reports."""
     import importlib.util
     import tempfile
     import urllib.error
@@ -778,6 +842,14 @@ def run_router(seconds: float, n_threads: int, preset: str) -> bool:
             "total_s": worst[0],
             "journey": worst[1].get("journey"),
             "hops": worst[1].get("hops")}
+    # performance-timeline artifact + flow-continuity gate (replicas must
+    # still be up: stitching fetches their /debug/timeline live): recent
+    # journeys' stitched fleet traces must show every request flow
+    # TERMINATED — an `s` (enqueue/route) without its `f` (finished) is a
+    # request the timeline lost track of. The richest stitched trace
+    # lands in TIMELINE_router.json, loadable in ui.perfetto.dev as-is.
+    tl_checked, tl_flows, tl_breaks = _timeline_audit(
+        base, "TIMELINE_router.json", stats)
     router_app.shutdown()
     for app in replicas:
         app.shutdown()
@@ -807,7 +879,8 @@ def run_router(seconds: float, n_threads: int, preset: str) -> bool:
     ok = (stats["errors"] == 0 and stats["shed"] == 0 and stats["ok"] > 0
           and sick_out_polls > 0 and recovered
           and hit_rate is not None and hit_rate > 0
-          and journeys_checked > 0 and not journey_orphans)
+          and journeys_checked > 0 and not journey_orphans
+          and tl_checked > 0 and tl_flows > 0 and not tl_breaks)
     stats["pass"] = ok
     print(json.dumps(stats))
     return ok
@@ -1730,6 +1803,11 @@ def run_elastic(seconds: float, n_threads: int, preset: str) -> bool:
             for k in ("launched", "draining", "scale_events")}
     except Exception:  # noqa: BLE001
         pass
+    # stitched performance timeline: even across a scale-up + drain +
+    # chaos storm, every recent journey's fleet trace must keep its
+    # request flows terminated; the richest one is the CI artifact
+    tl_checked, tl_flows, tl_breaks = _timeline_audit(
+        base, "TIMELINE_elastic.json", stats)
     router_app.shutdown()
     for app in launched_apps:
         app.shutdown()
@@ -1749,7 +1827,8 @@ def run_elastic(seconds: float, n_threads: int, preset: str) -> bool:
           and golden.get("shipped", 0) >= 1
           and golden.get("token_exact", 0) >= 1
           and len(migrated_with_gap) >= 1
-          and bool(drain_result.get("drained")))
+          and bool(drain_result.get("drained"))
+          and tl_checked > 0 and tl_flows > 0 and not tl_breaks)
     stats["pass"] = ok
     print(json.dumps(stats))
     return ok
